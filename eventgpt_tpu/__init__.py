@@ -1,0 +1,26 @@
+"""EventGPT-TPU: a TPU-native (JAX/XLA/Pallas) framework for event-camera multimodal LLMs.
+
+A ground-up re-design of the capabilities of ShifanZhu/EventGPT (CVPR 2025,
+arXiv 2412.00832) for TPU hardware: functional JAX models over parameter
+pytrees, pjit/`jax.sharding` parallelism over a ``Mesh(data, fsdp, model)``,
+Pallas kernels for hot host-independent ops, orbax checkpointing, and a C++
+native toolchain for offline sensor preprocessing.
+
+Layout (mirrors the reference's layer map, SURVEY.md §1):
+  - ``eventgpt_tpu.data``     prompts, tokenization, datasets, DSEC IO
+  - ``eventgpt_tpu.ops``      event rasterization, image preprocessing, pooling, sampling
+  - ``eventgpt_tpu.models``   CLIP ViT encoder, LLaMA decoder, projector, EventChat composition
+  - ``eventgpt_tpu.parallel`` mesh construction, shardings, ring attention, distributed init
+  - ``eventgpt_tpu.train``    optimizers/schedules, train steps (stage-1 / stage-2 LoRA), checkpointing
+  - ``eventgpt_tpu.cli``      inference / training / conversion entry points
+"""
+
+__version__ = "0.1.0"
+
+from eventgpt_tpu import constants  # noqa: F401
+from eventgpt_tpu.config import (  # noqa: F401
+    EventChatConfig,
+    LlamaConfig,
+    ProjectorConfig,
+    VisionConfig,
+)

@@ -1,0 +1,235 @@
+"""Unified typed configuration for the whole framework.
+
+The reference scatters configuration across four mechanisms (argparse CLI,
+HF config JSON with ad-hoc fields, HfArgumentParser dataclasses in the
+training pyc, and C++ YAML — SURVEY.md §5 "Config / flag system"). Here there
+is exactly one: frozen dataclasses, composable, JSON round-trippable, with a
+converter from HF-style ``config.json`` dicts for checkpoint interop
+(custom fields ``mm_visual_tower`` / ``event_feature_adaptor`` /
+``use_event_qformer`` per ``model/EventChatModel.py:71-81``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from eventgpt_tpu import constants
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    """CLIP ViT vision tower (reference: CLIP ViT-L/14-336, README.md:173-177)."""
+
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_layers: int = 24
+    num_heads: int = 16
+    image_size: int = 336
+    patch_size: int = 14
+    num_channels: int = 3
+    layer_norm_eps: float = 1e-5
+    # "quick_gelu" is CLIP's activation; kept configurable for other towers.
+    hidden_act: str = "quick_gelu"
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def num_tokens(self) -> int:
+        # +1 for the CLS token; ViT-L/14-336 -> 577.
+        return self.num_patches + 1
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    """LLaMA/Vicuna decoder-only LM."""
+
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 2048  # reference context cap: model/EventChatModel.py:378
+    tie_word_embeddings: bool = False
+
+    def resolved_head_dim(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.hidden_size // self.num_heads
+
+    @staticmethod
+    def llama_7b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def llama_13b() -> "LlamaConfig":
+        return LlamaConfig(
+            hidden_size=5120, intermediate_size=13824, num_layers=40,
+            num_heads=40, num_kv_heads=40,
+        )
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "LlamaConfig":
+        """Small config for tests / CPU-mesh dry runs."""
+        return LlamaConfig(
+            vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128,
+        )
+
+
+@dataclass(frozen=True)
+class ProjectorConfig:
+    """Event-feature -> LM-embedding projection stack.
+
+    Mirrors the reference stack: MLP(1024->4096, GELU, 4096->4096) projector
+    (``model/EventChatModel.py:87-93``, mlp_depth=2 at ``:67``) plus an optional
+    Linear(4096->4096) feature adaptor (``model/EventChatModel.py:75-76``).
+    """
+
+    input_dim: int = 1024
+    output_dim: int = 4096
+    mlp_depth: int = 2
+    use_feature_adaptor: bool = True
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh for pjit sharding (SURVEY.md §2.4).
+
+    Axes: ``data`` (pure DP), ``fsdp`` (ZeRO-style param sharding),
+    ``model`` (tensor parallel). A ``context`` axis for ring-attention
+    sequence parallelism is carved out of ``data`` when ``context > 1``.
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    model: int = 1
+    context: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.fsdp * self.model * self.context
+
+
+@dataclass(frozen=True)
+class EventChatConfig:
+    """Top-level multimodal model config (EventChat_llama equivalent)."""
+
+    vision: VisionConfig = field(default_factory=VisionConfig)
+    llama: LlamaConfig = field(default_factory=LlamaConfig)
+    projector: ProjectorConfig = field(default_factory=ProjectorConfig)
+
+    # Event pipeline envelope (common/common.py:114,118).
+    num_event_frames: int = constants.DEFAULT_NUM_EVENT_FRAMES
+    max_event_stream_us: int = constants.MAX_EVENT_STREAM_US
+    # None -> num_temporal_tokens == num frames (model/EventChatModel.py:24-25).
+    num_temporal_tokens: Optional[int] = None
+
+    mm_use_im_start_end: bool = False
+    mm_use_im_patch_token: bool = True
+
+    @property
+    def num_event_tokens(self) -> int:
+        """Tokens contributed by one event clip after spatio-temporal pooling."""
+        t = self.num_temporal_tokens if self.num_temporal_tokens is not None else self.num_event_frames
+        return t + self.vision.num_tokens  # 5 + 577 = 582 for defaults
+
+    @staticmethod
+    def eventgpt_7b() -> "EventChatConfig":
+        return EventChatConfig()
+
+    @staticmethod
+    def eventgpt_13b() -> "EventChatConfig":
+        return EventChatConfig(
+            llama=LlamaConfig.llama_13b(),
+            projector=ProjectorConfig(output_dim=5120),
+        )
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "EventChatConfig":
+        """Tiny end-to-end config for tests: real structure, toy dims."""
+        vision = VisionConfig(
+            hidden_size=32, intermediate_size=64, num_layers=2, num_heads=4,
+            image_size=28, patch_size=14,
+        )
+        llama = LlamaConfig.tiny(vocab_size)
+        proj = ProjectorConfig(input_dim=32, output_dim=llama.hidden_size)
+        return EventChatConfig(vision=vision, llama=llama, projector=proj)
+
+
+# ---------------------------------------------------------------------------
+# Serialization
+
+
+def to_dict(cfg: Any) -> Any:
+    if dataclasses.is_dataclass(cfg):
+        return {f.name: to_dict(getattr(cfg, f.name)) for f in dataclasses.fields(cfg)}
+    return cfg
+
+
+_NESTED = {"vision": VisionConfig, "llama": LlamaConfig, "projector": ProjectorConfig}
+
+
+def event_chat_config_from_dict(data: dict) -> EventChatConfig:
+    kwargs = {}
+    for f in dataclasses.fields(EventChatConfig):
+        if f.name not in data:
+            continue
+        v = data[f.name]
+        if f.name in _NESTED and isinstance(v, dict):
+            v = _NESTED[f.name](**v)
+        kwargs[f.name] = v
+    return EventChatConfig(**kwargs)
+
+
+def save_config(cfg: EventChatConfig, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(to_dict(cfg), f, indent=2)
+
+
+def load_config(path: str) -> EventChatConfig:
+    with open(path) as f:
+        return event_chat_config_from_dict(json.load(f))
+
+
+def from_hf_config(hf: dict) -> EventChatConfig:
+    """Build an EventChatConfig from an HF ``config.json`` dict.
+
+    Understands stock LLaMA fields plus the reference's custom gating fields
+    ``event_feature_adaptor`` / ``mm_use_im_start_end`` / ``mm_use_im_patch_token``
+    (``model/EventChatModel.py:75``, ``inference.py:33-34``).
+    """
+    llama = LlamaConfig(
+        vocab_size=hf.get("vocab_size", 32000),
+        hidden_size=hf.get("hidden_size", 4096),
+        intermediate_size=hf.get("intermediate_size", 11008),
+        num_layers=hf.get("num_hidden_layers", 32),
+        num_heads=hf.get("num_attention_heads", 32),
+        num_kv_heads=hf.get("num_key_value_heads", hf.get("num_attention_heads", 32)),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+        max_seq_len=min(hf.get("max_position_embeddings", 2048), 4096),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+    )
+    # Presence of the key — not its value — gates the adaptor, matching the
+    # reference's hasattr() check at model/EventChatModel.py:75-76.
+    proj = ProjectorConfig(
+        output_dim=llama.hidden_size,
+        use_feature_adaptor="event_feature_adaptor" in hf,
+    )
+    return EventChatConfig(
+        llama=llama,
+        projector=proj,
+        mm_use_im_start_end=hf.get("mm_use_im_start_end", False),
+        mm_use_im_patch_token=hf.get("mm_use_im_patch_token", True),
+    )

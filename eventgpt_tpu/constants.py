@@ -1,0 +1,24 @@
+"""Model-level token constants.
+
+Parity with the reference's ``dataset/constants.py:7-13``. The LLaVA serving
+heartbeat constants (``dataset/constants.py:1-4``) are deliberately dropped —
+no controller/worker server ships in the reference and none is needed here.
+"""
+
+# Label value ignored by the cross-entropy loss (masked positions).
+IGNORE_INDEX = -100
+
+# Sentinel id spliced into ``input_ids`` where event features are inserted.
+# Negative so it can never collide with a real vocabulary id.
+EVENT_TOKEN_INDEX = -200
+
+DEFAULT_EVENT_TOKEN = "<event>"
+DEFAULT_EVENT_PATCH_TOKEN = "<ev_patch>"
+DEFAULT_EV_START_TOKEN = "<ev_start>"
+DEFAULT_EV_END_TOKEN = "<ev_end>"
+EVENT_PLACEHOLDER = "<event-placeholder>"
+
+# Input envelope of the reference pipeline (``common/common.py:114,118``):
+# event streams are capped at 100 ms and rasterized into 5 frames.
+MAX_EVENT_STREAM_US = 100_000
+DEFAULT_NUM_EVENT_FRAMES = 5

@@ -1,0 +1,8 @@
+from eventgpt_tpu.data.conversation import (  # noqa: F401
+    Conversation,
+    SeparatorStyle,
+    conv_templates,
+    default_conversation,
+    prepare_event_prompt,
+)
+from eventgpt_tpu.data.tokenizer import tokenize_with_event  # noqa: F401

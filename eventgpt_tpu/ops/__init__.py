@@ -1,0 +1,9 @@
+from eventgpt_tpu.ops.raster import (  # noqa: F401
+    check_event_stream_length,
+    rasterize_events,
+    rasterize_events_jax,
+    split_events_by_count,
+    split_events_by_time,
+)
+from eventgpt_tpu.ops.image import clip_preprocess, clip_preprocess_batch  # noqa: F401
+from eventgpt_tpu.ops.pooling import spatio_temporal_pool  # noqa: F401

@@ -1,0 +1,109 @@
+"""CLIP image preprocessing with exact HF ``CLIPImageProcessor`` parity.
+
+The reference feeds rasterized event frames through
+``CLIPImageProcessor.__call__`` (``common/common.py:121-125``). Pixel-exact
+parity matters: an off-by-one in resampling changes every downstream event
+token (SURVEY.md §7 "Hard parts"). The host path therefore uses PIL bicubic
+resampling — the same code path HF uses — followed by center crop, rescale,
+and normalization in numpy. A pure-jnp normalize is provided for frames that
+are already device-resident at the target size.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from PIL import Image
+
+# OpenAI CLIP normalization constants (transformers OPENAI_CLIP_MEAN/STD).
+CLIP_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], dtype=np.float32)
+CLIP_STD = np.array([0.26862954, 0.26130258, 0.27577711], dtype=np.float32)
+
+
+def _resize_shortest_edge(img: Image.Image, shortest_edge: int) -> Image.Image:
+    """Resize preserving aspect ratio so min(H, W) == shortest_edge.
+
+    Matches transformers' ``get_resize_output_image_size`` with an int size:
+    the long side becomes ``int(shortest_edge * long / short)`` (floor).
+    """
+    w, h = img.size
+    short, long = (w, h) if w <= h else (h, w)
+    new_short = shortest_edge
+    new_long = int(shortest_edge * long / short)
+    new_w, new_h = (new_short, new_long) if w <= h else (new_long, new_short)
+    return img.resize((new_w, new_h), Image.Resampling.BICUBIC)
+
+
+def _center_crop(arr: np.ndarray, crop: int) -> np.ndarray:
+    """Center crop (H, W, C) to (crop, crop, C), zero-padding if smaller.
+
+    Offsets match transformers' ``center_crop`` ((dim - crop) // 2).
+    """
+    h, w = arr.shape[:2]
+    top = (h - crop) // 2
+    left = (w - crop) // 2
+    if top >= 0 and left >= 0:
+        return arr[top : top + crop, left : left + crop]
+    out = np.zeros((crop, crop, arr.shape[2]), dtype=arr.dtype)
+    dst_top, src_top = max(0, -top), max(0, top)
+    dst_left, src_left = max(0, -left), max(0, left)
+    hh = min(h, crop)
+    ww = min(w, crop)
+    out[dst_top : dst_top + hh, dst_left : dst_left + ww] = arr[
+        src_top : src_top + hh, src_left : src_left + ww
+    ]
+    return out
+
+
+def clip_preprocess(frame: np.ndarray, image_size: int = 336) -> np.ndarray:
+    """uint8 RGB (H, W, 3) -> normalized float32 CHW (3, S, S).
+
+    Pipeline (parity with CLIPImageProcessor defaults): bicubic resize of the
+    shortest edge to ``image_size``, center crop to ``image_size``², rescale
+    by 1/255, normalize with the OpenAI CLIP mean/std, HWC -> CHW.
+    """
+    img = Image.fromarray(frame)
+    img = _resize_shortest_edge(img, image_size)
+    arr = np.asarray(img, dtype=np.float32)
+    arr = _center_crop(arr, image_size)
+    arr = arr / 255.0
+    arr = (arr - CLIP_MEAN) / CLIP_STD
+    return np.transpose(arr, (2, 0, 1))
+
+
+def clip_preprocess_batch(frames: Iterable[np.ndarray], image_size: int = 336) -> np.ndarray:
+    """Preprocess a list of frames -> (N, 3, S, S) float32."""
+    return np.stack([clip_preprocess(f, image_size) for f in frames])
+
+
+def clip_normalize_jax(frames: jnp.ndarray) -> jnp.ndarray:
+    """Normalize device-resident uint8 NHWC frames already at target size.
+
+    For the on-device rasterize path (``rasterize_events_jax``) where resize
+    is done by the raster geometry itself. Returns NCHW float32.
+    """
+    x = frames.astype(jnp.float32) / 255.0
+    x = (x - jnp.asarray(CLIP_MEAN)) / jnp.asarray(CLIP_STD)
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+def process_event_file(
+    path: str,
+    n_frames: int = 5,
+    image_size: int = 336,
+) -> Tuple[List[int], np.ndarray]:
+    """npy path -> (event_image_size, (n_frames, 3, S, S) float32 pixels).
+
+    End-to-end host preprocessing, mirroring ``process_event_data``
+    (``common/common.py:110-127``): load, guard 100 ms span, 5-way
+    equal-count split, rasterize, CLIP preprocess. ``event_image_size`` is
+    the (H, W) of the first rasterized frame (``common/common.py:119``).
+    """
+    from eventgpt_tpu.ops.raster import events_to_frames, load_event_npy
+
+    events = load_event_npy(path)
+    frames = events_to_frames(events, n_frames=n_frames)
+    event_image_size = list(frames[0].shape[:2])
+    return event_image_size, clip_preprocess_batch(frames, image_size)

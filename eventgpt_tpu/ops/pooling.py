@@ -1,0 +1,40 @@
+"""Spatio-temporal aggregation of per-frame vision features.
+
+Parity with ``get_spatio_temporal_features`` (``model/EventChatModel.py:15-38``):
+given per-frame features (t, s, c),
+
+  * temporal tokens = mean over the spatial axis -> (t, c), row-padded with
+    zeros (or truncated) to ``num_temporal_tokens``;
+  * spatial tokens  = mean over the temporal axis -> (s, c);
+  * output = concat([temporal, spatial]) -> (t' + s, c).
+
+With t=5 frames and s=577 CLIP tokens this yields the reference's 582 event
+tokens. Pure jnp; shape-static, so it fuses into the surrounding jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def spatio_temporal_pool(
+    features: jnp.ndarray,
+    num_temporal_tokens: Optional[int] = None,
+) -> jnp.ndarray:
+    """(t, s, c) frame features -> (num_temporal_tokens + s, c) event tokens."""
+    if features.ndim != 3:
+        raise ValueError(f"expected (t, s, c) features, got shape {features.shape}")
+    t = features.shape[0]
+    if num_temporal_tokens is None:
+        num_temporal_tokens = t
+
+    temporal = features.mean(axis=1)  # (t, c)
+    if num_temporal_tokens > t:
+        temporal = jnp.pad(temporal, ((0, num_temporal_tokens - t), (0, 0)))
+    elif num_temporal_tokens < t:
+        temporal = temporal[:num_temporal_tokens]
+
+    spatial = features.mean(axis=0)  # (s, c)
+    return jnp.concatenate([temporal, spatial], axis=0)

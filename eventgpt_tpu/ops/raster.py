@@ -1,0 +1,155 @@
+"""Event-stream rasterization: raw ``{x, y, t, p}`` -> polarity RGB frames.
+
+Re-designs the reference's host-side per-event Python loop
+(``common/common.py:64-74``, the measured host hot spot at ~132k events per
+50 ms sample) as vectorized last-write-wins scatters:
+
+  * ``rasterize_events``      — numpy host path (data loading / preprocessing),
+  * ``rasterize_events_jax``  — jit-able device path (static frame dims) for
+    keeping rasterization on-TPU when events are already device-resident.
+
+Semantics match the reference exactly: white (255,255,255) background; the
+*last* event at a pixel wins; polarity 0 -> blue (0,0,255), polarity 1 ->
+red (255,0,0); per-frame dims are ``(y.max()+1, x.max()+1)`` computed from
+that frame's own events (``common/common.py:65``).
+
+Splitting matches ``get_event_images_list`` (equal event-count slices,
+``common/common.py:17-37``) and ``split_event_by_time``
+(fixed-width time bins, ``common/common.py:76-107``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eventgpt_tpu.constants import MAX_EVENT_STREAM_US
+
+EventDict = Dict[str, np.ndarray]
+
+_RED = np.array([255, 0, 0], dtype=np.uint8)
+_BLUE = np.array([0, 0, 255], dtype=np.uint8)
+
+
+class EventStreamTooLongError(ValueError):
+    """Stream span exceeds the supported envelope (common/common.py:114-116)."""
+
+
+def check_event_stream_length(start_time_us: int, end_time_us: int,
+                              max_span_us: int = MAX_EVENT_STREAM_US) -> None:
+    if end_time_us - start_time_us >= max_span_us:
+        raise EventStreamTooLongError(
+            f"Event stream spans {end_time_us - start_time_us} us; "
+            f"streams must be shorter than {max_span_us} us."
+        )
+
+
+def load_event_npy(path: str) -> EventDict:
+    """Load a ``{x,y,t,p}`` dict from an .npy file (``common/common.py:111-112``)."""
+    raw = np.load(path, allow_pickle=True)
+    return dict(np.array(raw).item())
+
+
+def rasterize_events(
+    x: np.ndarray,
+    y: np.ndarray,
+    p: np.ndarray,
+    height: Optional[int] = None,
+    width: Optional[int] = None,
+) -> np.ndarray:
+    """Rasterize one event slice into an (H, W, 3) uint8 RGB frame.
+
+    Vectorized last-write-wins: for each pixel, the polarity of the last
+    event landing there decides the color, identical to the sequential
+    overwrite loop at ``common/common.py:68-73``.
+    """
+    if height is None:
+        height = int(y.max()) + 1
+    if width is None:
+        width = int(x.max()) + 1
+
+    lin = y.astype(np.int64) * width + x.astype(np.int64)
+    last = np.full(height * width, -1, dtype=np.int64)
+    np.maximum.at(last, lin, np.arange(lin.size, dtype=np.int64))
+
+    frame = np.full((height * width, 3), 255, dtype=np.uint8)
+    hit = last >= 0
+    pol = np.asarray(p)[last[hit]]
+    frame[hit] = np.where(pol[:, None] != 0, _RED, _BLUE)
+    return frame.reshape(height, width, 3)
+
+
+def rasterize_events_jax(
+    x: jax.Array,
+    y: jax.Array,
+    p: jax.Array,
+    height: int,
+    width: int,
+) -> jax.Array:
+    """Device-side rasterization with static frame dims (jit/vmap friendly).
+
+    Last-write-wins via a scatter-max of event ordinals, then a gather of the
+    winning event's polarity — well-defined under XLA (unlike raw duplicate
+    scatter-set). Returns (H, W, 3) uint8.
+    """
+    n = x.shape[0]
+    lin = y.astype(jnp.int32) * width + x.astype(jnp.int32)
+    order = jnp.arange(n, dtype=jnp.int32)
+    last = jnp.full((height * width,), -1, dtype=jnp.int32).at[lin].max(order)
+    hit = last >= 0
+    pol = jnp.asarray(p)[jnp.clip(last, 0, None)]
+    red = jnp.array([255, 0, 0], dtype=jnp.uint8)
+    blue = jnp.array([0, 0, 255], dtype=jnp.uint8)
+    white = jnp.array([255, 255, 255], dtype=jnp.uint8)
+    colors = jnp.where(pol[:, None] != 0, red[None], blue[None])
+    frame = jnp.where(hit[:, None], colors, white[None])
+    return frame.reshape(height, width, 3)
+
+
+def split_events_by_count(events: EventDict, n: int) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Split a stream into ``n`` equal-event-count slices (last takes remainder).
+
+    Parity: ``common/common.py:17-37`` — slice i covers
+    ``[i*total//n, (i+1)*total//n)`` except the last, which runs to the end.
+    Returns (x, y, p) triples.
+    """
+    x, y, p, t = events["x"], events["y"], events["p"], events["t"]
+    total = len(t)
+    per = total // n
+    out = []
+    for i in range(n):
+        lo = i * per
+        hi = (i + 1) * per if i < n - 1 else total
+        out.append((x[lo:hi], y[lo:hi], p[lo:hi]))
+    return out
+
+
+def split_events_by_time(events: EventDict, time_interval_us: int = 50_000) -> List[EventDict]:
+    """Split a stream into fixed-width time bins (``common/common.py:76-107``)."""
+    t = events["t"]
+    bins = (t // time_interval_us) * time_interval_us
+    out = []
+    for b in np.unique(bins):
+        sel = bins == b
+        out.append({k: events[k][sel] for k in ("p", "t", "x", "y")})
+    return out
+
+
+def events_to_frames(
+    events: EventDict,
+    n_frames: int = 5,
+    max_span_us: int = MAX_EVENT_STREAM_US,
+) -> List[np.ndarray]:
+    """Full host path: guard span, split by count, rasterize each slice.
+
+    Mirrors ``process_event_data`` up to (but not including) CLIP
+    preprocessing (``common/common.py:110-119``).
+    """
+    t = events["t"]
+    if len(t) == 0:
+        raise ValueError("event stream is empty: nothing to rasterize")
+    check_event_stream_length(int(t.min()), int(t.max()), max_span_us)
+    return [rasterize_events(x, y, p) for x, y, p in split_events_by_count(events, n_frames)]

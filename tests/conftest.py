@@ -1,0 +1,32 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+Multi-chip sharding is validated without TPU hardware by asking XLA for 8
+host-platform devices (the TPU analog of multi-node simulation, SURVEY.md §4).
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+SAMPLE1 = "/root/reference/samples/sample1.npy"
+
+
+@pytest.fixture(scope="session")
+def sample1_events():
+    if not os.path.exists(SAMPLE1):
+        pytest.skip("reference sample1.npy not available")
+    raw = np.load(SAMPLE1, allow_pickle=True)
+    return dict(np.array(raw).item())
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

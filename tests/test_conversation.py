@@ -1,0 +1,73 @@
+"""Prompt templating and tokenizer splice parity."""
+
+import numpy as np
+
+from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
+from eventgpt_tpu.data.conversation import (
+    conv_templates,
+    prepare_event_prompt,
+    render_multiturn,
+)
+from eventgpt_tpu.data.tokenizer import ByteTokenizer, split_at_event, tokenize_with_event
+
+SYSTEM = (
+    "A chat between a curious human and an artificial intelligence assistant. "
+    "The assistant gives helpful, detailed, and polite answers to the human's questions."
+)
+
+
+def test_prepare_event_prompt_exact():
+    # Byte-exact against the reference template rendering
+    # (dataset/conversation.py:212-237: TWO style, sep=" ", sep2="</s>").
+    prompt = prepare_event_prompt("What is happening?", "eventgpt_v1")
+    expected = (
+        SYSTEM + " USER: <ev_start><event><ev_end>\nWhat is happening? ASSISTANT:"
+    )
+    assert prompt == expected
+
+
+def test_multiturn_two_style():
+    conv = conv_templates["eventgpt_v1"]
+    prompt = render_multiturn(
+        [(conv.roles[0], "hi"), (conv.roles[1], "hello"), (conv.roles[0], "bye"), (conv.roles[1], None)]
+    )
+    assert prompt == SYSTEM + " USER: hi ASSISTANT: hello</s>USER: bye ASSISTANT:"
+
+
+def test_plain_style():
+    prompt = render_multiturn([("", "<event>\na red car"), ("", None)], "eventgpt_plain")
+    assert prompt == "<event>\na red car\n"
+
+
+def test_tokenize_with_event_single():
+    tok = ByteTokenizer()
+    prompt = "ab<event>cd"
+    ids = tokenize_with_event(prompt, tok)
+    a, b, c, d = (ord(ch) + 3 for ch in "abcd")
+    assert ids == [tok.bos_token_id, a, b, EVENT_TOKEN_INDEX, c, d]
+
+
+def test_tokenize_with_event_multiple_and_roundtrip():
+    tok = ByteTokenizer()
+    ids = tokenize_with_event("x<event>y<event>z", tok)
+    assert ids.count(EVENT_TOKEN_INDEX) == 2
+    segs = split_at_event(ids)
+    assert len(segs) == 3
+    assert tok.decode(np.concatenate(segs)) == "xyz"
+
+
+def test_tokenize_no_event():
+    tok = ByteTokenizer()
+    ids = tokenize_with_event("hello", tok)
+    assert EVENT_TOKEN_INDEX not in ids
+    assert tok.decode(ids) == "hello"
+
+
+def test_byte_tokenizer_special_tokens():
+    tok = ByteTokenizer()
+    n0 = len(tok)
+    added = tok.add_tokens(["<ev_patch>", "<ev_start>"], special_tokens=True)
+    assert added == 2 and len(tok) == n0 + 2
+    ids = tok("<ev_start>hi")["input_ids"]
+    assert ids[1] == n0 + 1  # <ev_start> encodes as one id
+    assert tok.decode(ids) == "hi"

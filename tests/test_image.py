@@ -1,0 +1,49 @@
+"""CLIP preprocessing parity vs transformers' CLIPImageProcessor."""
+
+import numpy as np
+import pytest
+
+from eventgpt_tpu.ops.image import (
+    clip_normalize_jax,
+    clip_preprocess,
+    clip_preprocess_batch,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_processor():
+    from transformers import CLIPImageProcessor
+
+    # Locally constructed with ViT-L/14-336 geometry (no network): the
+    # constructor defaults already use the OpenAI CLIP mean/std.
+    return CLIPImageProcessor(
+        size={"shortest_edge": 336}, crop_size={"height": 336, "width": 336}
+    )
+
+
+@pytest.mark.parametrize("shape", [(480, 640), (478, 631), (336, 336), (200, 120)])
+def test_matches_hf_processor(rng, hf_processor, shape):
+    frame = rng.integers(0, 256, (*shape, 3)).astype(np.uint8)
+    ours = clip_preprocess(frame, 336)
+    theirs = hf_processor(frame, return_tensors="np")["pixel_values"][0]
+    assert ours.shape == theirs.shape == (3, 336, 336)
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_sample1_frames_match_hf(sample1_events, hf_processor):
+    from eventgpt_tpu.ops.raster import events_to_frames
+
+    frames = events_to_frames(sample1_events, n_frames=5)
+    ours = clip_preprocess_batch(frames, 336)
+    theirs = np.stack(
+        [hf_processor(f, return_tensors="np")["pixel_values"][0] for f in frames]
+    )
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_jax_normalize_matches_numpy(rng):
+    frames = rng.integers(0, 256, (2, 336, 336, 3)).astype(np.uint8)
+    out = np.asarray(clip_normalize_jax(frames))
+    # Against the host path minus resize/crop (identity at target size).
+    expected = np.stack([clip_preprocess(f, 336) for f in frames])
+    np.testing.assert_allclose(out, expected, atol=1e-5)

@@ -1,0 +1,39 @@
+"""Spatio-temporal pooling parity vs the reference semantics."""
+
+import numpy as np
+
+from eventgpt_tpu.ops.pooling import spatio_temporal_pool
+
+
+def reference_pool(features, num_temporal_tokens=None):
+    """Spec oracle for model/EventChatModel.py:15-38 (numpy)."""
+    t, s, c = features.shape
+    if num_temporal_tokens is None:
+        num_temporal_tokens = t
+    temporal = features.mean(axis=1)
+    if num_temporal_tokens > t:
+        temporal = np.concatenate(
+            [temporal, np.zeros((num_temporal_tokens - t, c), temporal.dtype)]
+        )
+    elif num_temporal_tokens < t:
+        temporal = temporal[:num_temporal_tokens]
+    spatial = features.mean(axis=0)
+    return np.concatenate([temporal, spatial], axis=0)
+
+
+def test_default_shape(rng):
+    f = rng.standard_normal((5, 577, 16)).astype(np.float32)
+    out = np.asarray(spatio_temporal_pool(f))
+    assert out.shape == (582, 16)
+    np.testing.assert_allclose(out, reference_pool(f), rtol=1e-6)
+
+
+def test_pad_and_truncate(rng):
+    f = rng.standard_normal((5, 7, 4)).astype(np.float32)
+    for ntt in (3, 5, 9):
+        out = np.asarray(spatio_temporal_pool(f, ntt))
+        assert out.shape == (ntt + 7, 4)
+        np.testing.assert_allclose(out, reference_pool(f, ntt), rtol=1e-6)
+    # Padded rows are exactly zero.
+    out = np.asarray(spatio_temporal_pool(f, 9))
+    assert (out[5:9] == 0).all()

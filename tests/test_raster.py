@@ -1,0 +1,107 @@
+"""Rasterization parity vs the reference's sequential-overwrite semantics."""
+
+import jax
+import numpy as np
+import pytest
+
+from eventgpt_tpu.ops.raster import (
+    EventStreamTooLongError,
+    check_event_stream_length,
+    events_to_frames,
+    rasterize_events,
+    rasterize_events_jax,
+    split_events_by_count,
+    split_events_by_time,
+)
+
+
+def reference_raster(x, y, p):
+    """Spec oracle: the sequential per-event overwrite loop (common/common.py:64-74)."""
+    h, w = int(y.max()) + 1, int(x.max()) + 1
+    img = np.ones((h, w, 3), dtype=np.uint8) * 255
+    for xi, yi, pi in zip(x, y, p):
+        img[yi, xi] = [0, 0, 255] if pi == 0 else [255, 0, 0]
+    return img
+
+
+def random_events(rng, n=5000, h=48, w=64):
+    return (
+        rng.integers(0, w, n).astype(np.uint16),
+        rng.integers(0, h, n).astype(np.uint16),
+        rng.integers(0, 2, n).astype(np.uint8),
+    )
+
+
+def test_raster_matches_sequential_loop(rng):
+    x, y, p = random_events(rng)
+    np.testing.assert_array_equal(rasterize_events(x, y, p), reference_raster(x, y, p))
+
+
+def test_raster_last_write_wins():
+    # Two events on the same pixel with opposite polarity: later one decides.
+    x = np.array([3, 3], dtype=np.uint16)
+    y = np.array([2, 2], dtype=np.uint16)
+    p = np.array([1, 0], dtype=np.uint8)
+    img = rasterize_events(x, y, p)
+    np.testing.assert_array_equal(img[2, 3], [0, 0, 255])  # blue: last was p=0
+
+
+def test_raster_jax_matches_numpy(rng):
+    x, y, p = random_events(rng)
+    h, w = int(y.max()) + 1, int(x.max()) + 1
+    jax_img = np.asarray(
+        jax.jit(rasterize_events_jax, static_argnums=(3, 4))(x, y, p, h, w)
+    )
+    np.testing.assert_array_equal(jax_img, rasterize_events(x, y, p, h, w))
+
+
+def test_split_by_count_boundaries(rng):
+    n = 103
+    events = {
+        "x": np.arange(n, dtype=np.uint16),
+        "y": np.zeros(n, dtype=np.uint16),
+        "p": np.ones(n, dtype=np.uint8),
+        "t": np.arange(n, dtype=np.uint32),
+    }
+    parts = split_events_by_count(events, 5)
+    # 103 // 5 = 20 per slice; last slice takes the remainder (23).
+    assert [len(p[0]) for p in parts] == [20, 20, 20, 20, 23]
+    assert parts[0][0][0] == 0 and parts[-1][0][-1] == n - 1
+
+
+def test_split_by_time_bins():
+    t = np.array([0, 10, 49_999, 50_000, 99_999], dtype=np.int64)
+    events = {"x": np.arange(5), "y": np.arange(5), "p": np.ones(5), "t": t}
+    parts = split_events_by_time(events, 50_000)
+    assert len(parts) == 2
+    assert len(parts[0]["t"]) == 3 and len(parts[1]["t"]) == 2
+
+
+def test_stream_length_guard():
+    check_event_stream_length(0, 99_999)
+    with pytest.raises(EventStreamTooLongError):
+        check_event_stream_length(0, 100_000)
+
+
+def test_sample1_end_to_end(sample1_events):
+    frames = events_to_frames(sample1_events, n_frames=5)
+    assert len(frames) == 5
+    # sample1: x in [0, 639], y in [0, 479]; each frame's dims come from its
+    # own slice maxima so they may be <= (480, 640).
+    for f in frames:
+        assert f.dtype == np.uint8 and f.ndim == 3 and f.shape[2] == 3
+        assert f.shape[0] <= 480 and f.shape[1] <= 640
+    # Frames must contain all three colors (background + both polarities).
+    flat = frames[0].reshape(-1, 3)
+    for color in ([255, 255, 255], [255, 0, 0], [0, 0, 255]):
+        assert (flat == color).all(axis=1).any()
+
+
+def test_sample1_matches_reference_loop(sample1_events):
+    x, y, p = (sample1_events[k] for k in ("x", "y", "p"))
+    # First equal-count slice of 5 (the full loop over 132k events is slow).
+    n = len(x) // 5
+    sl = slice(0, n)
+    np.testing.assert_array_equal(
+        rasterize_events(x[sl], y[sl], p[sl]), reference_raster(x[sl], y[sl], p[sl])
+    )
