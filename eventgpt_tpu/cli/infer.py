@@ -1,0 +1,133 @@
+"""Inference CLI: event stream + question -> answer, on TPU.
+
+Flag parity with the reference entry point (``inference.py:12-26``); the
+load-prep-generate-decode flow mirrors ``inference.py:28-66`` with the TPU
+pipeline underneath (jit CLIP encode, pjit-able LLaMA, HBM KV cache).
+
+Usage:
+  python -m eventgpt_tpu.cli.infer --model_path <hf_ckpt_dir|tiny-random> \\
+      --event_frame samples/sample1.npy --query "What is happening?"
+
+``--model_path tiny-random`` runs the full pipeline with tiny random weights
+and the offline byte tokenizer (no checkpoint/network needed) — useful as a
+smoke test of the end-to-end path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import jax
+import numpy as np
+
+from eventgpt_tpu import constants
+from eventgpt_tpu.config import EventChatConfig, from_hf_config
+from eventgpt_tpu.data.conversation import prepare_event_prompt
+from eventgpt_tpu.data.tokenizer import load_tokenizer, tokenize_with_event
+from eventgpt_tpu.models import convert, eventchat
+from eventgpt_tpu.models.llama import resize_token_embeddings
+from eventgpt_tpu.ops.image import process_event_file
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="EventGPT-TPU inference")
+    p.add_argument("--model_path", type=str, required=True)
+    p.add_argument("--model_base", type=str, default=None)
+    p.add_argument("--query", type=str, required=True)
+    p.add_argument("--conv_mode", type=str, default="eventgpt_v1")
+    p.add_argument("--sep", type=str, default=",")
+    p.add_argument("--context_len", type=int, default=2048)
+    p.add_argument("--temperature", type=float, default=0.6)
+    p.add_argument("--top_p", type=float, default=1.0)
+    p.add_argument("--num_beams", type=int, default=1)
+    p.add_argument("--max_new_tokens", type=int, default=512)
+    p.add_argument("--spatial_temporal_encoder", type=bool, default=True)
+    p.add_argument("--event_frame", type=str, required=True)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--dtype", type=str, default="bfloat16",
+                   choices=["bfloat16", "float32"])
+    p.add_argument("--timing", action="store_true", help="print stage timings to stderr")
+    return p
+
+
+def load_model(model_path: str, dtype: str):
+    """Returns (config, params, tokenizer)."""
+    import jax.numpy as jnp
+
+    jdt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    if model_path == "tiny-random":
+        cfg = EventChatConfig.tiny()
+        params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0), jdt)
+        tokenizer = load_tokenizer("byte")
+        return cfg, params, tokenizer
+
+    with open(os.path.join(model_path, "config.json")) as f:
+        hf_cfg = json.load(f)
+    cfg = from_hf_config(hf_cfg)
+    sd = convert.load_state_dict(model_path)
+    params = convert.eventchat_params_from_hf(sd, cfg)
+    params = jax.tree_util.tree_map(lambda x: jax.numpy.asarray(x, jdt), params)
+    tokenizer = load_tokenizer(model_path)
+    return cfg, params, tokenizer
+
+
+def main(argv=None) -> str:
+    args = build_parser().parse_args(argv)
+    if args.num_beams != 1:
+        raise NotImplementedError("beam search is not supported; use sampling or greedy")
+
+    t0 = time.perf_counter()
+    cfg, params, tokenizer = load_model(args.model_path, args.dtype)
+
+    # Special-token registration parity with inference.py:33-39.
+    added = 0
+    if cfg.mm_use_im_patch_token:
+        added += tokenizer.add_tokens([constants.DEFAULT_EVENT_PATCH_TOKEN], special_tokens=True)
+    if cfg.mm_use_im_start_end:
+        added += tokenizer.add_tokens(
+            [constants.DEFAULT_EV_START_TOKEN, constants.DEFAULT_EV_END_TOKEN],
+            special_tokens=True,
+        )
+    if len(tokenizer) > cfg.llama.vocab_size:
+        params["llama"] = resize_token_embeddings(params["llama"], len(tokenizer))
+    t_load = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    prompt = prepare_event_prompt(args.query, args.conv_mode)
+    event_image_size, pixels = process_event_file(
+        args.event_frame, cfg.num_event_frames, cfg.vision.image_size
+    )
+    input_ids = tokenize_with_event(prompt, tokenizer)
+    t_prep = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out_ids = eventchat.generate(
+        params, cfg,
+        [input_ids], pixels[None],
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature,
+        top_p=args.top_p,
+        eos_token_id=getattr(tokenizer, "eos_token_id", None),
+        seed=args.seed,
+    )[0]
+    t_gen = time.perf_counter() - t0
+
+    output = tokenizer.batch_decode([out_ids], skip_special_tokens=True)[0].strip()
+    if args.timing:
+        import sys
+
+        n = max(len(out_ids), 1)
+        print(
+            f"[timing] load={t_load:.2f}s prep={t_prep:.2f}s generate={t_gen:.2f}s "
+            f"({n} tokens, {n / t_gen:.2f} tok/s)",
+            file=sys.stderr,
+        )
+    print(output)
+    return output
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from eventgpt_tpu.models import clip, convert, eventchat, llama, projector  # noqa: F401

@@ -1,0 +1,130 @@
+"""CLIP ViT vision encoder, TPU-first.
+
+Functional JAX reimplementation of the frozen vision tower the reference
+wraps (``model/EventChatModel.py:45-59`` wrapping HF ``CLIPVisionModel``;
+ViT-L/14-336 per README.md:173-177). Numerics match HF's
+``CLIPVisionModel(...).last_hidden_state`` — i.e. the final encoder layer
+output *without* post-layernorm, which is exactly what the reference feeds
+the projector (``model/EventChatModel.py:185-191``).
+
+TPU-first choices:
+  * patch embedding as a single flattened matmul (MXU-friendly; equivalent to
+    the stride=kernel conv),
+  * all encoder layers stacked on a leading axis and driven by ``lax.scan``
+    (O(1) compile time in depth, natural fsdp/tp sharding of the stack),
+  * f32 softmax accumulation inside attention regardless of param dtype.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from eventgpt_tpu.config import VisionConfig
+
+Params = Dict[str, Any]
+
+
+def quick_gelu(x: jnp.ndarray) -> jnp.ndarray:
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray, eps: float) -> jnp.ndarray:
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(axis=-1, keepdims=True)
+    var = x32.var(axis=-1, keepdims=True)
+    out = (x32 - mean) * lax.rsqrt(var + eps)
+    return (out * scale + bias).astype(x.dtype)
+
+
+def init_clip_params(cfg: VisionConfig, key: jax.Array, dtype=jnp.float32) -> Params:
+    """Random init with HF-compatible shapes (for tests and cold starts)."""
+    d, i, l = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    patch_dim = cfg.num_channels * cfg.patch_size**2
+    keys = jax.random.split(key, 12)
+
+    def dense(k, fan_in, shape):
+        return jax.random.normal(k, shape, dtype) * (1.0 / math.sqrt(fan_in))
+
+    return {
+        "embeddings": {
+            "class_embedding": jax.random.normal(keys[0], (d,), dtype) * 0.02,
+            "patch_embedding": dense(keys[1], patch_dim, (patch_dim, d)),
+            "position_embedding": jax.random.normal(keys[2], (cfg.num_tokens, d), dtype) * 0.02,
+        },
+        "pre_layernorm": {"scale": jnp.ones((d,), dtype), "bias": jnp.zeros((d,), dtype)},
+        "layers": {
+            "ln1": {"scale": jnp.ones((l, d), dtype), "bias": jnp.zeros((l, d), dtype)},
+            "attn": {
+                "q": {"kernel": dense(keys[3], d, (l, d, d)), "bias": jnp.zeros((l, d), dtype)},
+                "k": {"kernel": dense(keys[4], d, (l, d, d)), "bias": jnp.zeros((l, d), dtype)},
+                "v": {"kernel": dense(keys[5], d, (l, d, d)), "bias": jnp.zeros((l, d), dtype)},
+                "o": {"kernel": dense(keys[6], d, (l, d, d)), "bias": jnp.zeros((l, d), dtype)},
+            },
+            "ln2": {"scale": jnp.ones((l, d), dtype), "bias": jnp.zeros((l, d), dtype)},
+            "mlp": {
+                "fc1": {"kernel": dense(keys[7], d, (l, d, i)), "bias": jnp.zeros((l, i), dtype)},
+                "fc2": {"kernel": dense(keys[8], i, (l, i, d)), "bias": jnp.zeros((l, d), dtype)},
+            },
+        },
+        "post_layernorm": {"scale": jnp.ones((d,), dtype), "bias": jnp.zeros((d,), dtype)},
+    }
+
+
+def _embed_patches(params: Params, cfg: VisionConfig, pixel_values: jnp.ndarray) -> jnp.ndarray:
+    """(B, C, H, W) -> (B, 1 + N, D) token embeddings with CLS + positions."""
+    b = pixel_values.shape[0]
+    p = cfg.patch_size
+    g = cfg.image_size // p
+    # Flatten each patch in (c, i, j) order to match the HF Conv2d kernel layout.
+    x = pixel_values.reshape(b, cfg.num_channels, g, p, g, p)
+    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(b, g * g, cfg.num_channels * p * p)
+    patches = x @ params["embeddings"]["patch_embedding"]
+    cls = jnp.broadcast_to(params["embeddings"]["class_embedding"], (b, 1, cfg.hidden_size))
+    tokens = jnp.concatenate([cls.astype(patches.dtype), patches], axis=1)
+    return tokens + params["embeddings"]["position_embedding"]
+
+
+def _attention(x: jnp.ndarray, attn: Params, cfg: VisionConfig) -> jnp.ndarray:
+    b, s, d = x.shape
+    h, hd = cfg.num_heads, cfg.head_dim
+
+    def proj(p):
+        return (x @ p["kernel"] + p["bias"]).reshape(b, s, h, hd)
+
+    q = proj(attn["q"]) * (1.0 / math.sqrt(hd))
+    k = proj(attn["k"])
+    v = proj(attn["v"])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, d)
+    return ctx @ attn["o"]["kernel"] + attn["o"]["bias"]
+
+
+def clip_encode(params: Params, cfg: VisionConfig, pixel_values: jnp.ndarray) -> jnp.ndarray:
+    """(B, C, H, W) pixels -> (B, num_tokens, D) last hidden state (no post-LN)."""
+    x = _embed_patches(params, cfg, pixel_values)
+    x = layer_norm(x, params["pre_layernorm"]["scale"], params["pre_layernorm"]["bias"],
+                   cfg.layer_norm_eps)
+
+    def block(carry, layer):
+        y = layer_norm(carry, layer["ln1"]["scale"], layer["ln1"]["bias"], cfg.layer_norm_eps)
+        carry = carry + _attention(y, layer["attn"], cfg)
+        y = layer_norm(carry, layer["ln2"]["scale"], layer["ln2"]["bias"], cfg.layer_norm_eps)
+        y = quick_gelu(y @ layer["mlp"]["fc1"]["kernel"] + layer["mlp"]["fc1"]["bias"])
+        y = y @ layer["mlp"]["fc2"]["kernel"] + layer["mlp"]["fc2"]["bias"]
+        return carry + y, None
+
+    x, _ = lax.scan(block, x, params["layers"])
+    return x
+
+
+def clip_pooled(params: Params, cfg: VisionConfig, pixel_values: jnp.ndarray) -> jnp.ndarray:
+    """Post-layernormed CLS token (HF ``pooler_output`` equivalent)."""
+    last = clip_encode(params, cfg, pixel_values)
+    return layer_norm(last[:, 0], params["post_layernorm"]["scale"],
+                      params["post_layernorm"]["bias"], cfg.layer_norm_eps)

@@ -1,0 +1,210 @@
+"""HF checkpoint -> JAX param-tree conversion.
+
+Converts PyTorch EventChat/LLaMA/CLIP state dicts into this framework's
+stacked-layer pytrees. Understands the reference's checkpoint layout, where
+the vision tower and projector live inside the LLM state dict under the
+prefixes established at ``model/EventChatModel.py:72-76,128-161``:
+
+  model.visual_tower.visual_tower.vision_model.*   (HF CLIPVisionModel)
+  model.visual_projector.{0,2}.{weight,bias}        (nn.Sequential MLP)
+  model.feature_adaptor.{weight,bias}
+  model.layers.* / model.embed_tokens / model.norm / lm_head  (HF LLaMA)
+
+Also reads the reference's *partial* component checkpoints (raw torch.load
+files holding just projector/adaptor weights, ``model/EventChatModel.py:
+124-139``) so stage-1 artifacts can be imported directly.
+
+All functions take/return numpy-backed dicts; torch is only touched inside
+the file loaders so converted checkpoints can be cached as orbax and torch
+never enters the TPU hot path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from eventgpt_tpu.config import EventChatConfig, LlamaConfig, VisionConfig
+
+StateDict = Dict[str, np.ndarray]
+Params = Dict[str, Any]
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    """torch Linear stores (out, in); JAX matmul kernels want (in, out)."""
+    return np.ascontiguousarray(x.T)
+
+
+def clip_params_from_hf(sd: StateDict, cfg: VisionConfig, prefix: str = "vision_model.") -> Params:
+    g = lambda k: np.asarray(sd[prefix + k])
+    d = cfg.hidden_size
+
+    patch = g("embeddings.patch_embedding.weight")  # (D, C, P, P)
+    patch = patch.reshape(d, -1).T  # -> (C*P*P, D), (c,i,j) flatten order
+
+    def stack(fmt, transpose=False):
+        rows = [np.asarray(sd[prefix + fmt.format(i)]) for i in range(cfg.num_layers)]
+        return np.stack([_t(r) if transpose else r for r in rows])
+
+    return {
+        "embeddings": {
+            "class_embedding": g("embeddings.class_embedding"),
+            "patch_embedding": patch,
+            "position_embedding": g("embeddings.position_embedding.weight"),
+        },
+        # sic: HF spells it "pre_layrnorm".
+        "pre_layernorm": {"scale": g("pre_layrnorm.weight"), "bias": g("pre_layrnorm.bias")},
+        "layers": {
+            "ln1": {
+                "scale": stack("encoder.layers.{}.layer_norm1.weight"),
+                "bias": stack("encoder.layers.{}.layer_norm1.bias"),
+            },
+            "attn": {
+                "q": {"kernel": stack("encoder.layers.{}.self_attn.q_proj.weight", True),
+                      "bias": stack("encoder.layers.{}.self_attn.q_proj.bias")},
+                "k": {"kernel": stack("encoder.layers.{}.self_attn.k_proj.weight", True),
+                      "bias": stack("encoder.layers.{}.self_attn.k_proj.bias")},
+                "v": {"kernel": stack("encoder.layers.{}.self_attn.v_proj.weight", True),
+                      "bias": stack("encoder.layers.{}.self_attn.v_proj.bias")},
+                "o": {"kernel": stack("encoder.layers.{}.self_attn.out_proj.weight", True),
+                      "bias": stack("encoder.layers.{}.self_attn.out_proj.bias")},
+            },
+            "ln2": {
+                "scale": stack("encoder.layers.{}.layer_norm2.weight"),
+                "bias": stack("encoder.layers.{}.layer_norm2.bias"),
+            },
+            "mlp": {
+                "fc1": {"kernel": stack("encoder.layers.{}.mlp.fc1.weight", True),
+                        "bias": stack("encoder.layers.{}.mlp.fc1.bias")},
+                "fc2": {"kernel": stack("encoder.layers.{}.mlp.fc2.weight", True),
+                        "bias": stack("encoder.layers.{}.mlp.fc2.bias")},
+            },
+        },
+        "post_layernorm": {"scale": g("post_layernorm.weight"), "bias": g("post_layernorm.bias")},
+    }
+
+
+def llama_params_from_hf(sd: StateDict, cfg: LlamaConfig, prefix: str = "model.") -> Params:
+    def stack(fmt):
+        return np.stack([_t(np.asarray(sd[prefix + fmt.format(i)])) for i in range(cfg.num_layers)])
+
+    def stack_norm(fmt):
+        return np.stack([np.asarray(sd[prefix + fmt.format(i)]) for i in range(cfg.num_layers)])
+
+    embed = np.asarray(sd[prefix + "embed_tokens.weight"])
+    if "lm_head.weight" in sd:
+        lm_head = _t(np.asarray(sd["lm_head.weight"]))
+    else:  # tied embeddings
+        lm_head = _t(embed)
+
+    return {
+        "embed_tokens": embed,
+        "layers": {
+            "input_norm": stack_norm("layers.{}.input_layernorm.weight"),
+            "attn": {
+                "q": stack("layers.{}.self_attn.q_proj.weight"),
+                "k": stack("layers.{}.self_attn.k_proj.weight"),
+                "v": stack("layers.{}.self_attn.v_proj.weight"),
+                "o": stack("layers.{}.self_attn.o_proj.weight"),
+            },
+            "post_norm": stack_norm("layers.{}.post_attention_layernorm.weight"),
+            "mlp": {
+                "gate": stack("layers.{}.mlp.gate_proj.weight"),
+                "up": stack("layers.{}.mlp.up_proj.weight"),
+                "down": stack("layers.{}.mlp.down_proj.weight"),
+            },
+        },
+        "final_norm": np.asarray(sd[prefix + "norm.weight"]),
+        "lm_head": lm_head,
+    }
+
+
+def projector_params_from_hf(sd: StateDict, mlp_depth: int = 2,
+                             prefix: str = "model.visual_projector.",
+                             adaptor_prefix: Optional[str] = "model.feature_adaptor.") -> Params:
+    """Sequential [Linear, GELU, Linear, ...] -> our layer list (index 2j)."""
+    layers = []
+    for j in range(mlp_depth):
+        layers.append({
+            "kernel": _t(np.asarray(sd[f"{prefix}{2 * j}.weight"])),
+            "bias": np.asarray(sd[f"{prefix}{2 * j}.bias"]),
+        })
+    params: Params = {"mlp": layers}
+    if adaptor_prefix is not None and adaptor_prefix + "weight" in sd:
+        params["adaptor"] = {
+            "kernel": _t(np.asarray(sd[adaptor_prefix + "weight"])),
+            "bias": np.asarray(sd[adaptor_prefix + "bias"]),
+        }
+    return params
+
+
+def eventchat_params_from_hf(sd: StateDict, cfg: EventChatConfig) -> Params:
+    """Full EventChat_llama state dict -> {clip, projector, llama} pytree."""
+    return {
+        "clip": clip_params_from_hf(
+            sd, cfg.vision, prefix="model.visual_tower.visual_tower.vision_model."
+        ),
+        "projector": projector_params_from_hf(sd, cfg.projector.mlp_depth),
+        "llama": llama_params_from_hf(sd, cfg.llama, prefix="model."),
+    }
+
+
+# ---------------------------------------------------------------------------
+# File loaders (torch/safetensors touched only here)
+
+
+def load_state_dict(model_path: str) -> StateDict:
+    """Load a (possibly sharded) HF checkpoint directory into numpy arrays.
+
+    Handles ``*.safetensors`` shards and ``pytorch_model*.bin`` torch files —
+    the loading surface behind ``from_pretrained`` at ``inference.py:30``.
+    """
+    sd: StateDict = {}
+    entries = sorted(os.listdir(model_path))
+    safes = [e for e in entries if e.endswith(".safetensors")]
+    bins = [e for e in entries if e.startswith("pytorch_model") and e.endswith(".bin")]
+    if safes:
+        from safetensors import safe_open
+
+        for shard in safes:
+            with safe_open(os.path.join(model_path, shard), framework="np") as f:
+                for k in f.keys():
+                    sd[k] = f.get_tensor(k)
+    elif bins:
+        import torch
+
+        for shard in bins:
+            for k, v in torch.load(
+                os.path.join(model_path, shard), map_location="cpu", weights_only=True
+            ).items():
+                sd[k] = v.float().numpy() if v.dtype == torch.bfloat16 else v.numpy()
+    else:
+        raise FileNotFoundError(f"no safetensors/bin checkpoint found under {model_path}")
+    return sd
+
+
+def load_partial_module(path: str, strip_prefix: str) -> StateDict:
+    """Read a reference-style partial checkpoint (raw torch.load dict).
+
+    Mirrors the key-prefix rewriting at ``model/EventChatModel.py:124-139``:
+    e.g. ``strip_prefix='model.feature_adaptor.'``.
+    """
+    import torch
+
+    raw = torch.load(path, map_location="cpu", weights_only=True)
+    out: StateDict = {}
+    for k, v in raw.items():
+        if k.startswith(strip_prefix):
+            k = k[len(strip_prefix):]
+        out[k] = v.float().numpy() if v.dtype == torch.bfloat16 else v.numpy()
+    return out
+
+
+def state_dict_from_torch_module(module) -> StateDict:
+    """torch nn.Module -> numpy state dict (test utility)."""
+    return {
+        k: (v.float().numpy() if str(v.dtype) == "torch.bfloat16" else v.detach().numpy())
+        for k, v in module.state_dict().items()
+    }

@@ -1,0 +1,199 @@
+"""EventChat: the multimodal composition (vision tower + projector + LLM).
+
+TPU-first redesign of ``model/EventChatModel.py``. The reference interleaves
+ragged Python list surgery with HF generate (``prepare_inputs_labels_for_
+multimodal``, ``:292-428``); here the same semantics factor into three clean
+jit units (the seam identified in SURVEY.md §3.3):
+
+  1. ``encode_events``  — CLIP -> projector -> adaptor -> spatio-temporal pool
+  2. ``prefill``        — spliced prompt embeddings through the LM, KV cache fill
+  3. ``decode_step``    — single-token autoregressive step on the HBM cache
+
+The embedding splice itself (``splice_embeddings``) is static-shape: the
+host splits ids at the -200 sentinel once, and the device concatenates
+[text embeds | event tokens | text embeds]. Batching right-pads to a shared
+length exactly like the reference (``model/EventChatModel.py:383-413``,
+padding_side='right'), and the spliced sequence is truncated to the model
+context (``:378-381``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.models import clip as clip_mod
+from eventgpt_tpu.models import llama as llama_mod
+from eventgpt_tpu.models import projector as proj_mod
+from eventgpt_tpu.ops.pooling import spatio_temporal_pool
+from eventgpt_tpu.ops.sampling import sample
+
+Params = Dict[str, Any]
+
+
+def init_eventchat_params(cfg: EventChatConfig, key: jax.Array, dtype=jnp.float32) -> Params:
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "clip": clip_mod.init_clip_params(cfg.vision, k1, dtype),
+        "projector": proj_mod.init_projector_params(cfg.projector, k2, dtype),
+        "llama": llama_mod.init_llama_params(cfg.llama, k3, dtype),
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def encode_events(params: Params, cfg: EventChatConfig, pixel_values: jnp.ndarray) -> jnp.ndarray:
+    """(T, C, H, W) frames -> (num_event_tokens, D_lm) pooled event tokens.
+
+    Parity chain: frozen CLIP last_hidden_state -> MLP projector -> feature
+    adaptor -> spatio-temporal pooling (``model/EventChatModel.py:185-191``,
+    ``:304-312``). The CLIP output is wrapped in stop_gradient — the exact
+    JAX statement of the reference's detach-then-requires_grad trick that
+    confines gradients to the projector stack.
+    """
+    feats = clip_mod.clip_encode(params["clip"], cfg.vision, pixel_values)
+    feats = jax.lax.stop_gradient(feats)
+    feats = proj_mod.apply_projector(params["projector"], feats)
+    feats = proj_mod.apply_adaptor(params["projector"], feats)
+    return spatio_temporal_pool(feats, cfg.num_temporal_tokens)
+
+
+def encode_events_batch(params: Params, cfg: EventChatConfig, pixel_values: jnp.ndarray) -> jnp.ndarray:
+    """(B, T, C, H, W) -> (B, num_event_tokens, D_lm)."""
+    return jax.vmap(lambda pv: encode_events(params, cfg, pv))(pixel_values)
+
+
+def splice_embeddings(
+    params: Params,
+    cfg: EventChatConfig,
+    segments: Sequence[np.ndarray],
+    event_tokens: jnp.ndarray,
+) -> jnp.ndarray:
+    """Interleave text-segment embeddings with event-token blocks.
+
+    ``segments`` are the host-side id chunks around each -200 sentinel
+    (``split_at_event``); ``event_tokens`` is (num_events, n_tok, D) or
+    (n_tok, D) for a single clip. Returns (T, D).
+    """
+    if event_tokens.ndim == 2:
+        event_tokens = event_tokens[None]
+    num_events = len(segments) - 1
+    if event_tokens.shape[0] != num_events:
+        raise ValueError(
+            f"{num_events} event sentinel(s) in prompt but "
+            f"{event_tokens.shape[0]} event clip(s) provided"
+        )
+    parts: List[jnp.ndarray] = []
+    for i, seg in enumerate(segments):
+        if len(seg):
+            ids = jnp.asarray(np.asarray(seg, dtype=np.int32))
+            parts.append(llama_mod.embed_tokens(params["llama"], ids))
+        if i < num_events:
+            parts.append(event_tokens[i].astype(parts[-1].dtype if parts else jnp.float32))
+    out = jnp.concatenate(parts, axis=0)
+    return out[: cfg.llama.max_seq_len]
+
+
+def _pad_batch(embeds: List[jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray, np.ndarray]:
+    """Right-pad per-sample (T_i, D) embeds to (B, T_max, D) + bool mask."""
+    lens = np.array([int(e.shape[0]) for e in embeds])
+    t_max = int(lens.max())
+    padded = jnp.stack([
+        jnp.pad(e, ((0, t_max - e.shape[0]), (0, 0))) for e in embeds
+    ])
+    mask = jnp.asarray(np.arange(t_max)[None, :] < lens[:, None])
+    return padded, mask, lens
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _prefill_jit(params, cfg: EventChatConfig, embeds, mask, cache):
+    return llama_mod.prefill(params["llama"], cfg.llama, embeds, mask, cache)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _decode_jit(params, cfg: EventChatConfig, tokens, cache):
+    token_embeds = llama_mod.embed_tokens(params["llama"], tokens[:, None])
+    return llama_mod.decode_step(params["llama"], cfg.llama, token_embeds, cache)
+
+
+def generate(
+    params: Params,
+    cfg: EventChatConfig,
+    input_ids_batch: Sequence[Sequence[int]],
+    pixel_values_batch: jnp.ndarray,
+    max_new_tokens: int = 512,
+    temperature: float = 0.0,
+    top_p: float = 1.0,
+    eos_token_id: Optional[int] = 2,
+    seed: int = 0,
+    bucket: int = 128,
+) -> List[List[int]]:
+    """Autoregressive generation over a batch of event-QA prompts.
+
+    Flag parity with the reference run (``inference.py:52-63``): sampling is
+    enabled iff temperature > 0, nucleus top_p, greedy otherwise; decode
+    stops per-row at EOS or after ``max_new_tokens``.
+
+    ``input_ids_batch``: token ids containing -200 sentinels.
+    ``pixel_values_batch``: (B, T_frames, C, H, W).
+    """
+    from eventgpt_tpu.data.tokenizer import split_at_event
+
+    compute_dtype = jax.tree_util.tree_leaves(params["llama"])[0].dtype
+
+    event_tokens = encode_events_batch(
+        params, cfg, jnp.asarray(pixel_values_batch, dtype=compute_dtype)
+    )
+    embeds = [
+        splice_embeddings(params, cfg, split_at_event(ids), event_tokens[i])
+        for i, ids in enumerate(input_ids_batch)
+    ]
+    padded, mask, lens = _pad_batch(embeds)
+    b, t = padded.shape[:2]
+
+    # Bucket the cache length to stabilize compiled shapes across prompts.
+    max_len = t + max_new_tokens
+    max_len = ((max_len + bucket - 1) // bucket) * bucket
+    cache = llama_mod.init_kv_cache(cfg.llama, b, max_len, dtype=compute_dtype)
+
+    logits, cache = _prefill_jit(params, cfg, padded, mask, cache)
+    last_logits = logits[jnp.arange(b), lens - 1]
+
+    key = jax.random.PRNGKey(seed)
+    out_tokens = np.zeros((b, max_new_tokens), np.int32)
+    done = np.zeros((b,), bool)
+
+    for step in range(max_new_tokens):
+        key, sub = jax.random.split(key)
+        next_tok = sample(last_logits, sub, temperature, top_p)
+        tok_host = np.asarray(next_tok)
+        out_tokens[:, step] = tok_host
+        done |= (tok_host == eos_token_id) if eos_token_id is not None else False
+        if done.all():
+            break
+        last_logits, cache = _decode_jit(params, cfg, next_tok, cache)
+
+    results: List[List[int]] = []
+    for i in range(b):
+        row = out_tokens[i]
+        ids: List[int] = []
+        for tid in row[: step + 1]:
+            if eos_token_id is not None and tid == eos_token_id:
+                break
+            ids.append(int(tid))
+        results.append(ids)
+    return results
+
+
+def forward_train(
+    params: Params,
+    cfg: EventChatConfig,
+    inputs_embeds: jnp.ndarray,
+    attention_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """Training forward: spliced embeds -> logits (B, T, V)."""
+    return llama_mod.forward(params["llama"], cfg.llama, inputs_embeds, attention_mask)

@@ -1,0 +1,272 @@
+"""LLaMA/Vicuna decoder-only LM, TPU-first.
+
+Functional JAX reimplementation of the reference's HF ``LlamaForCausalLM``
+backbone (``model/EventChatModel.py:166-176``): RMSNorm, RoPE, GQA-capable
+attention, SwiGLU MLP. Numerics match HF LLaMA.
+
+TPU-first design (SURVEY.md §7):
+  * layers stacked on a leading axis, driven by ``lax.scan`` — O(1) compile
+    time in depth; the stacked axis shards cleanly under fsdp;
+  * the decode path is split into three jit units — ``prefill`` (batched
+    matmuls over the whole prompt, writes the KV cache) and ``decode_step``
+    (one token, reads the HBM-resident cache) — mirroring the reference's
+    one-shot multimodal embed + HF generate loop seam
+    (``model/EventChatModel.py:296-297``, SURVEY.md §3.3);
+  * f32 softmax/logit accumulation under bf16 params;
+  * accepts ``inputs_embeds`` directly, because the multimodal path splices
+    event features into the embedding sequence before the LM ever runs.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from eventgpt_tpu.config import LlamaConfig
+
+Params = Dict[str, Any]
+KVCache = Dict[str, jnp.ndarray]  # {"k": [L,B,S,KV,hd], "v": [L,B,S,KV,hd], "length": [B]}
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    x32 = x.astype(jnp.float32)
+    norm = x32 * lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (norm * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_tables(cfg: LlamaConfig, positions: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for given positions: (..., head_dim) each, f32.
+
+    HF convention: inv_freq over even indices, table is concat(freqs, freqs),
+    rotation by rotate_half (split at head_dim/2).
+    """
+    hd = cfg.resolved_head_dim()
+    inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., hd/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x: (B, S, H, hd); cos/sin: (B, S, hd) -> rotated x (HF rotate_half)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    cos = cos[:, :, None, :].astype(x.dtype)
+    sin = sin[:, :, None, :].astype(x.dtype)
+    return x * cos + rotated * sin
+
+
+def init_llama_params(cfg: LlamaConfig, key: jax.Array, dtype=jnp.float32) -> Params:
+    d, i, l = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    hd = cfg.resolved_head_dim()
+    qd, kvd = cfg.num_heads * hd, cfg.num_kv_heads * hd
+    keys = jax.random.split(key, 8)
+
+    def dense(k, fan_in, shape):
+        return jax.random.normal(k, shape, dtype) * (1.0 / math.sqrt(fan_in))
+
+    return {
+        "embed_tokens": jax.random.normal(keys[0], (cfg.vocab_size, d), dtype) * 0.02,
+        "layers": {
+            "input_norm": jnp.ones((l, d), dtype),
+            "attn": {
+                "q": dense(keys[1], d, (l, d, qd)),
+                "k": dense(keys[2], d, (l, d, kvd)),
+                "v": dense(keys[3], d, (l, d, kvd)),
+                "o": dense(keys[4], qd, (l, qd, d)),
+            },
+            "post_norm": jnp.ones((l, d), dtype),
+            "mlp": {
+                "gate": dense(keys[5], d, (l, d, i)),
+                "up": dense(keys[6], d, (l, d, i)),
+                "down": dense(keys[7], i, (l, i, d)),
+            },
+        },
+        "final_norm": jnp.ones((d,), dtype),
+        "lm_head": dense(keys[0], d, (d, cfg.vocab_size)),
+    }
+
+
+def embed_tokens(params: Params, input_ids: jnp.ndarray) -> jnp.ndarray:
+    return params["embed_tokens"][input_ids]
+
+
+def resize_token_embeddings(params: Params, new_vocab_size: int) -> Params:
+    """Grow embed/lm_head rows, initializing new rows to the mean of old ones.
+
+    Mirrors ``resize_token_embeddings`` + the mean-init of
+    ``initialize_vision_tokenizer`` (``model/EventChatModel.py:202-212``,
+    ``inference.py:39``). Shrinking truncates.
+    """
+    embed = params["embed_tokens"]
+    head = params["lm_head"]
+    old = embed.shape[0]
+    if new_vocab_size <= old:
+        return {**params, "embed_tokens": embed[:new_vocab_size],
+                "lm_head": head[:, :new_vocab_size]}
+    n_new = new_vocab_size - old
+    embed_new = jnp.concatenate(
+        [embed, jnp.broadcast_to(embed.mean(axis=0, keepdims=True), (n_new, embed.shape[1]))]
+    )
+    head_new = jnp.concatenate(
+        [head, jnp.broadcast_to(head.mean(axis=1, keepdims=True), (head.shape[0], n_new))],
+        axis=1,
+    )
+    return {**params, "embed_tokens": embed_new, "lm_head": head_new}
+
+
+def _repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """(B, S, KV, hd) -> (B, S, KV*n_rep, hd), GQA head replication."""
+    if n_rep == 1:
+        return x
+    b, s, kv, hd = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, kv, n_rep, hd)).reshape(b, s, kv * n_rep, hd)
+
+
+def _attn_block(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
+                cos: jnp.ndarray, sin: jnp.ndarray,
+                k_full: jnp.ndarray, v_full: jnp.ndarray,
+                mask: jnp.ndarray) -> jnp.ndarray:
+    """Shared attention math. x: (B,Q,D); k/v_full: (B,S,KV,hd); mask: (B,1,Q,S)."""
+    b, q_len, d = x.shape
+    h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.resolved_head_dim()
+
+    q = (x @ layer["attn"]["q"]).reshape(b, q_len, h, hd)
+    q = apply_rope(q, cos, sin)
+    k = _repeat_kv(k_full, h // kvh)
+    v = _repeat_kv(v_full, h // kvh)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores * (1.0 / math.sqrt(hd)) + mask
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, q_len, h * hd)
+    return ctx @ layer["attn"]["o"]
+
+
+def _mlp_block(x: jnp.ndarray, layer: Params) -> jnp.ndarray:
+    gate = jax.nn.silu(x @ layer["mlp"]["gate"])
+    return (gate * (x @ layer["mlp"]["up"])) @ layer["mlp"]["down"]
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.bfloat16) -> KVCache:
+    hd = cfg.resolved_head_dim()
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, hd)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "length": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def prefill(
+    params: Params,
+    cfg: LlamaConfig,
+    inputs_embeds: jnp.ndarray,
+    attention_mask: jnp.ndarray,
+    cache: KVCache,
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Run the full prompt; returns (logits [B, T, V], filled cache).
+
+    ``attention_mask`` is bool (B, T): True = real token, False = right pad.
+    The prompt occupies cache slots [0, T); cache["length"] records the true
+    per-row prompt length for the decode phase.
+    """
+    b, t, d = inputs_embeds.shape
+    positions = jnp.cumsum(attention_mask.astype(jnp.int32), axis=1) - 1
+    positions = jnp.maximum(positions, 0)
+    cos, sin = rope_tables(cfg, positions)
+
+    causal = jnp.tril(jnp.ones((t, t), bool))
+    visible = causal[None, None] & attention_mask[:, None, None, :]
+    mask = jnp.where(visible, 0.0, jnp.finfo(jnp.float32).min)
+
+    x = inputs_embeds
+
+    def block(carry, xs):
+        layer, = xs
+        h_in = carry
+        y = rms_norm(h_in, layer["input_norm"], cfg.rms_norm_eps)
+        k = (y @ layer["attn"]["k"]).reshape(b, t, cfg.num_kv_heads, -1)
+        k = apply_rope(k, cos, sin)
+        v = (y @ layer["attn"]["v"]).reshape(b, t, cfg.num_kv_heads, -1)
+        h_mid = h_in + _attn_block(cfg, y, layer, cos, sin, k, v, mask)
+        y2 = rms_norm(h_mid, layer["post_norm"], cfg.rms_norm_eps)
+        h_out = h_mid + _mlp_block(y2, layer)
+        return h_out, (k, v)
+
+    x, (k_all, v_all) = lax.scan(block, x, (params["layers"],))
+
+    max_len = cache["k"].shape[2]
+    pad = max_len - t
+    new_cache = {
+        "k": jnp.pad(k_all.astype(cache["k"].dtype), ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+        "v": jnp.pad(v_all.astype(cache["v"].dtype), ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+        "length": attention_mask.astype(jnp.int32).sum(axis=1),
+    }
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def decode_step(
+    params: Params,
+    cfg: LlamaConfig,
+    token_embeds: jnp.ndarray,
+    cache: KVCache,
+) -> Tuple[jnp.ndarray, KVCache]:
+    """One decode step. token_embeds: (B, 1, D). Returns (logits [B, V], cache).
+
+    The new token lands at slot ``cache["length"]`` with position id equal to
+    the number of real tokens so far (right-pad-free positions).
+    """
+    b = token_embeds.shape[0]
+    max_len = cache["k"].shape[2]
+    pos = cache["length"]  # (B,)
+    cos, sin = rope_tables(cfg, pos[:, None])
+
+    slot = pos  # write index per batch row
+    valid = jnp.arange(max_len)[None, :] <= slot[:, None]  # (B, S) incl. new slot
+    mask = jnp.where(valid[:, None, None, :], 0.0, jnp.finfo(jnp.float32).min)
+
+    batch_idx = jnp.arange(b)
+
+    def block(carry, xs):
+        layer, k_cache, v_cache = xs
+        h_in = carry
+        y = rms_norm(h_in, layer["input_norm"], cfg.rms_norm_eps)
+        k_new = (y @ layer["attn"]["k"]).reshape(b, 1, cfg.num_kv_heads, -1)
+        k_new = apply_rope(k_new, cos, sin)
+        v_new = (y @ layer["attn"]["v"]).reshape(b, 1, cfg.num_kv_heads, -1)
+        k_cache = k_cache.at[batch_idx, slot].set(k_new[:, 0].astype(k_cache.dtype))
+        v_cache = v_cache.at[batch_idx, slot].set(v_new[:, 0].astype(v_cache.dtype))
+        h_mid = h_in + _attn_block(cfg, y, layer, cos, sin,
+                                   k_cache.astype(h_in.dtype), v_cache.astype(h_in.dtype), mask)
+        y2 = rms_norm(h_mid, layer["post_norm"], cfg.rms_norm_eps)
+        h_out = h_mid + _mlp_block(y2, layer)
+        return h_out, (k_cache, v_cache)
+
+    x, (k_all, v_all) = lax.scan(block, token_embeds, (params["layers"], cache["k"], cache["v"]))
+    new_cache = {"k": k_all, "v": v_all, "length": cache["length"] + 1}
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def forward(
+    params: Params,
+    cfg: LlamaConfig,
+    inputs_embeds: jnp.ndarray,
+    attention_mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Cache-free full forward -> logits (B, T, V). Training / eval path."""
+    b, t, _ = inputs_embeds.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, t), bool)
+    cache = init_kv_cache(cfg, b, t, dtype=inputs_embeds.dtype)
+    logits, _ = prefill(params, cfg, inputs_embeds, attention_mask, cache)
+    return logits

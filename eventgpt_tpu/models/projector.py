@@ -1,0 +1,58 @@
+"""Event-feature projection stack: MLP projector + optional feature adaptor.
+
+Parity with the reference stack: ``build_mlp_projector`` — Linear(1024->D),
+then (GELU, Linear(D->D)) x (mlp_depth-1) (``model/EventChatModel.py:87-93``)
+— and the Linear(D->D) ``feature_adaptor`` (``model/EventChatModel.py:75-76``).
+GELU is torch's default (exact erf form).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from eventgpt_tpu.config import ProjectorConfig
+
+Params = Dict[str, Any]
+
+
+def init_projector_params(cfg: ProjectorConfig, key: jax.Array, dtype=jnp.float32) -> Params:
+    keys = jax.random.split(key, cfg.mlp_depth + 1)
+
+    def linear(k, fan_in, fan_out):
+        # torch nn.Linear default: U(-1/sqrt(fan_in), 1/sqrt(fan_in)) for both.
+        bound = 1.0 / math.sqrt(fan_in)
+        wk, bk = jax.random.split(k)
+        return {
+            "kernel": jax.random.uniform(wk, (fan_in, fan_out), dtype, -bound, bound),
+            "bias": jax.random.uniform(bk, (fan_out,), dtype, -bound, bound),
+        }
+
+    layers = [linear(keys[0], cfg.input_dim, cfg.output_dim)]
+    for i in range(1, cfg.mlp_depth):
+        layers.append(linear(keys[i], cfg.output_dim, cfg.output_dim))
+    params: Params = {"mlp": layers}
+    if cfg.use_feature_adaptor:
+        params["adaptor"] = linear(keys[-1], cfg.output_dim, cfg.output_dim)
+    return params
+
+
+def apply_projector(params: Params, features: jnp.ndarray) -> jnp.ndarray:
+    """(..., input_dim) CLIP features -> (..., output_dim) LM-space features."""
+    x = features
+    for i, layer in enumerate(params["mlp"]):
+        if i > 0:
+            x = jax.nn.gelu(x, approximate=False)
+        x = x @ layer["kernel"] + layer["bias"]
+    return x
+
+
+def apply_adaptor(params: Params, features: jnp.ndarray) -> jnp.ndarray:
+    """Feature adaptor Linear; identity when the adaptor is disabled."""
+    ad: Optional[Params] = params.get("adaptor")
+    if ad is None:
+        return features
+    return features @ ad["kernel"] + ad["bias"]

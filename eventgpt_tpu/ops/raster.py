@@ -149,7 +149,10 @@ def events_to_frames(
     preprocessing (``common/common.py:110-119``).
     """
     t = events["t"]
-    if len(t) == 0:
-        raise ValueError("event stream is empty: nothing to rasterize")
+    if len(t) < n_frames:
+        raise ValueError(
+            f"event stream has {len(t)} events; at least {n_frames} are needed "
+            f"to rasterize {n_frames} frames"
+        )
     check_event_stream_length(int(t.min()), int(t.max()), max_span_us)
     return [rasterize_events(x, y, p) for x, y, p in split_events_by_count(events, n_frames)]

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# TPU-native analog of the reference launcher (script/EventGPT_inference.sh):
+# no CUDA_VISIBLE_DEVICES — device selection is JAX's; flags are identical.
+set -euo pipefail
+MODEL_PATH=${MODEL_PATH:-tiny-random}
+python -m eventgpt_tpu.cli.infer \
+  --model_path "$MODEL_PATH" \
+  --event_frame "${EVENT_FRAME:-/root/reference/samples/sample1.npy}" \
+  --query "${QUERY:-What happened in the video?}" \
+  --temperature "${TEMPERATURE:-0.4}" \
+  --top_p 1 \
+  --max_new_tokens 512

@@ -1,0 +1,61 @@
+"""Numerical parity of the JAX CLIP encoder vs HF CLIPVisionModel (tiny)."""
+
+import numpy as np
+import pytest
+
+from eventgpt_tpu.config import VisionConfig
+from eventgpt_tpu.models.clip import clip_encode, clip_pooled, init_clip_params
+from eventgpt_tpu.models.convert import clip_params_from_hf, state_dict_from_torch_module
+
+TINY = VisionConfig(
+    hidden_size=32, intermediate_size=64, num_layers=2, num_heads=4,
+    image_size=28, patch_size=14,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    import torch
+    from transformers import CLIPVisionConfig, CLIPVisionModel
+
+    torch.manual_seed(0)
+    cfg = CLIPVisionConfig(
+        hidden_size=TINY.hidden_size, intermediate_size=TINY.intermediate_size,
+        num_hidden_layers=TINY.num_layers, num_attention_heads=TINY.num_heads,
+        image_size=TINY.image_size, patch_size=TINY.patch_size,
+    )
+    return CLIPVisionModel(cfg).eval()
+
+
+def test_last_hidden_state_parity(hf_model, rng):
+    import torch
+
+    pixels = rng.standard_normal((2, 3, 28, 28)).astype(np.float32)
+    with torch.no_grad():
+        expected = hf_model(torch.from_numpy(pixels)).last_hidden_state.numpy()
+
+    params = clip_params_from_hf(state_dict_from_torch_module(hf_model), TINY)
+    ours = np.asarray(clip_encode(params, TINY, pixels))
+    assert ours.shape == expected.shape == (2, TINY.num_tokens, TINY.hidden_size)
+    np.testing.assert_allclose(ours, expected, atol=2e-5)
+
+
+def test_pooler_parity(hf_model, rng):
+    import torch
+
+    pixels = rng.standard_normal((1, 3, 28, 28)).astype(np.float32)
+    with torch.no_grad():
+        expected = hf_model(torch.from_numpy(pixels)).pooler_output.numpy()
+    params = clip_params_from_hf(state_dict_from_torch_module(hf_model), TINY)
+    ours = np.asarray(clip_pooled(params, TINY, pixels))
+    np.testing.assert_allclose(ours, expected, atol=2e-5)
+
+
+def test_random_init_shapes_match_hf(hf_model):
+    import jax
+
+    params = init_clip_params(TINY, jax.random.PRNGKey(0))
+    converted = clip_params_from_hf(state_dict_from_torch_module(hf_model), TINY)
+    ours = jax.tree_util.tree_map(lambda x: x.shape, params)
+    theirs = jax.tree_util.tree_map(lambda x: x.shape, converted)
+    assert ours == theirs

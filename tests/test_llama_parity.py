@@ -1,0 +1,125 @@
+"""Numerical parity of the JAX LLaMA vs HF LlamaForCausalLM (tiny), plus
+KV-cache decode consistency."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgpt_tpu.config import LlamaConfig
+from eventgpt_tpu.models.convert import llama_params_from_hf, state_dict_from_torch_module
+from eventgpt_tpu.models.llama import (
+    decode_step,
+    embed_tokens,
+    forward,
+    init_kv_cache,
+    init_llama_params,
+    prefill,
+)
+
+TINY = LlamaConfig.tiny(vocab_size=128)
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    import torch
+    from transformers import LlamaConfig as HFLlamaConfig
+    from transformers import LlamaForCausalLM
+
+    torch.manual_seed(0)
+    cfg = HFLlamaConfig(
+        vocab_size=TINY.vocab_size, hidden_size=TINY.hidden_size,
+        intermediate_size=TINY.intermediate_size, num_hidden_layers=TINY.num_layers,
+        num_attention_heads=TINY.num_heads, num_key_value_heads=TINY.num_kv_heads,
+        max_position_embeddings=TINY.max_seq_len, rms_norm_eps=TINY.rms_norm_eps,
+        attn_implementation="eager",
+    )
+    return LlamaForCausalLM(cfg).eval()
+
+
+@pytest.fixture(scope="module")
+def params(hf_model):
+    return llama_params_from_hf(state_dict_from_torch_module(hf_model), TINY)
+
+
+def test_logits_parity(hf_model, params, rng):
+    import torch
+
+    ids = rng.integers(0, TINY.vocab_size, (2, 17))
+    with torch.no_grad():
+        expected = hf_model(torch.from_numpy(ids)).logits.numpy()
+
+    embeds = embed_tokens(params, jnp.asarray(ids))
+    ours = np.asarray(forward(params, TINY, embeds))
+    assert ours.shape == expected.shape
+    np.testing.assert_allclose(ours, expected, atol=3e-4)
+
+
+def test_logits_parity_with_padding(hf_model, params, rng):
+    import torch
+
+    ids = rng.integers(0, TINY.vocab_size, (2, 12))
+    mask = np.ones((2, 12), bool)
+    mask[0, 8:] = False  # right-pad row 0
+    with torch.no_grad():
+        expected = hf_model(
+            torch.from_numpy(ids), attention_mask=torch.from_numpy(mask)
+        ).logits.numpy()
+
+    embeds = embed_tokens(params, jnp.asarray(ids))
+    ours = np.asarray(forward(params, TINY, embeds, jnp.asarray(mask)))
+    # Compare only valid positions (HF emits arbitrary values at pads too).
+    np.testing.assert_allclose(ours[mask], expected[mask], atol=3e-4)
+
+
+def test_decode_matches_prefill(params, rng):
+    """Incremental KV-cache decode must equal the cache-free full forward."""
+    ids = rng.integers(0, TINY.vocab_size, (2, 9))
+    embeds = embed_tokens(params, jnp.asarray(ids))
+
+    full = np.asarray(forward(params, TINY, embeds))
+
+    prompt_len = 5
+    cache = init_kv_cache(TINY, 2, 16, dtype=jnp.float32)
+    mask = jnp.ones((2, prompt_len), bool)
+    logits, cache = prefill(params, TINY, embeds[:, :prompt_len], mask, cache)
+    np.testing.assert_allclose(np.asarray(logits), full[:, :prompt_len], atol=1e-4)
+
+    for t in range(prompt_len, 9):
+        step_logits, cache = decode_step(params, TINY, embeds[:, t : t + 1], cache)
+        np.testing.assert_allclose(np.asarray(step_logits), full[:, t], atol=1e-4)
+
+
+def test_decode_with_ragged_prompts(params, rng):
+    """Rows with different true lengths decode at their own cache slots."""
+    lens = [4, 7]
+    t = 7
+    ids = rng.integers(0, TINY.vocab_size, (2, t))
+    mask = np.arange(t)[None, :] < np.array(lens)[:, None]
+    embeds = embed_tokens(params, jnp.asarray(ids))
+
+    cache = init_kv_cache(TINY, 2, 16, dtype=jnp.float32)
+    logits, cache = prefill(params, TINY, embeds, jnp.asarray(mask), cache)
+    assert np.asarray(cache["length"]).tolist() == lens
+
+    # Row 0's next step must match an unpadded single-row run.
+    cache0 = init_kv_cache(TINY, 1, 16, dtype=jnp.float32)
+    l0, cache0 = prefill(params, TINY, embeds[:1, :4], jnp.ones((1, 4), bool), cache0)
+    np.testing.assert_allclose(np.asarray(logits[0, 3]), np.asarray(l0[0, 3]), atol=1e-4)
+
+    nxt = embed_tokens(params, jnp.asarray(ids[:, :1]))  # arbitrary next token
+    s_batch, _ = decode_step(params, TINY, nxt, cache)
+    s_single, _ = decode_step(params, TINY, nxt[:1], cache0)
+    np.testing.assert_allclose(np.asarray(s_batch[0]), np.asarray(s_single[0]), atol=1e-4)
+
+
+def test_gqa_shapes():
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_seq_len=32,
+    )
+    params = init_llama_params(cfg, jax.random.PRNGKey(0))
+    assert params["layers"]["attn"]["k"].shape == (2, 32, 2 * 8)
+    embeds = embed_tokens(params, jnp.zeros((1, 5), jnp.int32))
+    logits = forward(params, cfg, embeds)
+    assert logits.shape == (1, 5, 64)
