@@ -32,6 +32,14 @@ from eventgpt_tpu.models.llama import resize_token_embeddings
 from eventgpt_tpu.ops.image import process_event_file
 
 
+def _str2bool(v: str) -> bool:
+    if v.lower() in ("true", "1", "yes"):
+        return True
+    if v.lower() in ("false", "0", "no"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected bool, got {v!r}")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="EventGPT-TPU inference")
     p.add_argument("--model_path", type=str, required=True)
@@ -44,7 +52,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--top_p", type=float, default=1.0)
     p.add_argument("--num_beams", type=int, default=1)
     p.add_argument("--max_new_tokens", type=int, default=512)
-    p.add_argument("--spatial_temporal_encoder", type=bool, default=True)
+    p.add_argument("--spatial_temporal_encoder", type=_str2bool, default=True,
+                   help="pool frame features spatio-temporally (reference default)")
     p.add_argument("--event_frame", type=str, required=True)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--dtype", type=str, default="bfloat16",
@@ -81,13 +90,16 @@ def main(argv=None) -> str:
 
     t0 = time.perf_counter()
     cfg, params, tokenizer = load_model(args.model_path, args.dtype)
+    if args.spatial_temporal_encoder != cfg.use_spatio_temporal_pool:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, use_spatio_temporal_pool=args.spatial_temporal_encoder)
 
     # Special-token registration parity with inference.py:33-39.
-    added = 0
     if cfg.mm_use_im_patch_token:
-        added += tokenizer.add_tokens([constants.DEFAULT_EVENT_PATCH_TOKEN], special_tokens=True)
+        tokenizer.add_tokens([constants.DEFAULT_EVENT_PATCH_TOKEN], special_tokens=True)
     if cfg.mm_use_im_start_end:
-        added += tokenizer.add_tokens(
+        tokenizer.add_tokens(
             [constants.DEFAULT_EV_START_TOKEN, constants.DEFAULT_EV_END_TOKEN],
             special_tokens=True,
         )
@@ -97,7 +109,7 @@ def main(argv=None) -> str:
 
     t0 = time.perf_counter()
     prompt = prepare_event_prompt(args.query, args.conv_mode)
-    event_image_size, pixels = process_event_file(
+    _, pixels = process_event_file(
         args.event_frame, cfg.num_event_frames, cfg.vision.image_size
     )
     input_ids = tokenize_with_event(prompt, tokenizer)
@@ -112,6 +124,7 @@ def main(argv=None) -> str:
         top_p=args.top_p,
         eos_token_id=getattr(tokenizer, "eos_token_id", None),
         seed=args.seed,
+        max_context=args.context_len,
     )[0]
     t_gen = time.perf_counter() - t0
 

@@ -134,13 +134,18 @@ class EventChatConfig:
     max_event_stream_us: int = constants.MAX_EVENT_STREAM_US
     # None -> num_temporal_tokens == num frames (model/EventChatModel.py:24-25).
     num_temporal_tokens: Optional[int] = None
+    # spatial_temporal_encoder flag of the training pyc (SURVEY.md §2.2);
+    # False feeds raw per-frame patch tokens to the LM instead of pooling.
+    use_spatio_temporal_pool: bool = True
 
     mm_use_im_start_end: bool = False
     mm_use_im_patch_token: bool = True
 
     @property
     def num_event_tokens(self) -> int:
-        """Tokens contributed by one event clip after spatio-temporal pooling."""
+        """Tokens contributed by one event clip after the encode stage."""
+        if not self.use_spatio_temporal_pool:
+            return self.num_event_frames * self.vision.num_tokens
         t = self.num_temporal_tokens if self.num_temporal_tokens is not None else self.num_event_frames
         return t + self.vision.num_tokens  # 5 + 577 = 582 for defaults
 

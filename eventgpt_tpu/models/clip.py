@@ -99,7 +99,7 @@ def _attention(x: jnp.ndarray, attn: Params, cfg: VisionConfig) -> jnp.ndarray:
     q = proj(attn["q"]) * (1.0 / math.sqrt(hd))
     k = proj(attn["k"])
     v = proj(attn["v"])
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, d)
     return ctx @ attn["o"]["kernel"] + attn["o"]["bias"]

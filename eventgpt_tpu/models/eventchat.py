@@ -59,6 +59,10 @@ def encode_events(params: Params, cfg: EventChatConfig, pixel_values: jnp.ndarra
     feats = jax.lax.stop_gradient(feats)
     feats = proj_mod.apply_projector(params["projector"], feats)
     feats = proj_mod.apply_adaptor(params["projector"], feats)
+    if not cfg.use_spatio_temporal_pool:
+        # spatial_temporal_encoder=False path: raw per-frame patch tokens,
+        # frames concatenated along the token axis.
+        return feats.reshape(-1, feats.shape[-1])
     return spatio_temporal_pool(feats, cfg.num_temporal_tokens)
 
 
@@ -72,12 +76,15 @@ def splice_embeddings(
     cfg: EventChatConfig,
     segments: Sequence[np.ndarray],
     event_tokens: jnp.ndarray,
+    max_context: Optional[int] = None,
 ) -> jnp.ndarray:
     """Interleave text-segment embeddings with event-token blocks.
 
     ``segments`` are the host-side id chunks around each -200 sentinel
     (``split_at_event``); ``event_tokens`` is (num_events, n_tok, D) or
-    (n_tok, D) for a single clip. Returns (T, D).
+    (n_tok, D) for a single clip. Returns (T, D), truncated to the smaller
+    of the model context and ``max_context`` (the reference's 2048 cap,
+    ``model/EventChatModel.py:378-381``).
     """
     if event_tokens.ndim == 2:
         event_tokens = event_tokens[None]
@@ -87,15 +94,17 @@ def splice_embeddings(
             f"{num_events} event sentinel(s) in prompt but "
             f"{event_tokens.shape[0]} event clip(s) provided"
         )
+    embed_dtype = params["llama"]["embed_tokens"].dtype
     parts: List[jnp.ndarray] = []
     for i, seg in enumerate(segments):
         if len(seg):
             ids = jnp.asarray(np.asarray(seg, dtype=np.int32))
             parts.append(llama_mod.embed_tokens(params["llama"], ids))
         if i < num_events:
-            parts.append(event_tokens[i].astype(parts[-1].dtype if parts else jnp.float32))
+            parts.append(event_tokens[i].astype(embed_dtype))
     out = jnp.concatenate(parts, axis=0)
-    return out[: cfg.llama.max_seq_len]
+    limit = cfg.llama.max_seq_len if max_context is None else min(cfg.llama.max_seq_len, max_context)
+    return out[:limit]
 
 
 def _pad_batch(embeds: List[jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray, np.ndarray]:
@@ -131,6 +140,7 @@ def generate(
     eos_token_id: Optional[int] = 2,
     seed: int = 0,
     bucket: int = 128,
+    max_context: Optional[int] = None,
 ) -> List[List[int]]:
     """Autoregressive generation over a batch of event-QA prompts.
 
@@ -149,7 +159,7 @@ def generate(
         params, cfg, jnp.asarray(pixel_values_batch, dtype=compute_dtype)
     )
     embeds = [
-        splice_embeddings(params, cfg, split_at_event(ids), event_tokens[i])
+        splice_embeddings(params, cfg, split_at_event(ids), event_tokens[i], max_context)
         for i, ids in enumerate(input_ids_batch)
     ]
     padded, mask, lens = _pad_batch(embeds)
@@ -166,22 +176,24 @@ def generate(
     key = jax.random.PRNGKey(seed)
     out_tokens = np.zeros((b, max_new_tokens), np.int32)
     done = np.zeros((b,), bool)
+    num_steps = 0
 
     for step in range(max_new_tokens):
         key, sub = jax.random.split(key)
         next_tok = sample(last_logits, sub, temperature, top_p)
         tok_host = np.asarray(next_tok)
         out_tokens[:, step] = tok_host
+        num_steps = step + 1
         done |= (tok_host == eos_token_id) if eos_token_id is not None else False
-        if done.all():
-            break
+        if done.all() or step == max_new_tokens - 1:
+            break  # skip the forward pass whose logits would never be used
         last_logits, cache = _decode_jit(params, cfg, next_tok, cache)
 
     results: List[List[int]] = []
     for i in range(b):
         row = out_tokens[i]
         ids: List[int] = []
-        for tid in row[: step + 1]:
+        for tid in row[:num_steps]:
             if eos_token_id is not None and tid == eos_token_id:
                 break
             ids.append(int(tid))

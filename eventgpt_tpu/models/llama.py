@@ -141,7 +141,7 @@ def _attn_block(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
     k = _repeat_kv(k_full, h // kvh)
     v = _repeat_kv(v_full, h // kvh)
 
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     scores = scores * (1.0 / math.sqrt(hd)) + mask
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, q_len, h * hd)
@@ -209,7 +209,7 @@ def prefill(
         "length": attention_mask.astype(jnp.int32).sum(axis=1),
     }
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = jnp.matmul(x, params["lm_head"], preferred_element_type=jnp.float32)
     return logits, new_cache
 
 
@@ -253,7 +253,7 @@ def decode_step(
     x, (k_all, v_all) = lax.scan(block, token_embeds, (params["layers"], cache["k"], cache["v"]))
     new_cache = {"k": k_all, "v": v_all, "length": cache["length"] + 1}
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    logits = jnp.matmul(x[:, 0], params["lm_head"], preferred_element_type=jnp.float32)
     return logits, new_cache
 
 

@@ -1,0 +1,23 @@
+"""Parallelism layer: device mesh, sharding rules, distributed bootstrap.
+
+The reference has zero in-tree parallelism (SURVEY.md §2.4) — its distributed
+story was torch DDP / DeepSpeed ZeRO / NCCL in external packages. Here the
+equivalent is a first-class subsystem built on ``jax.sharding``:
+
+  * :mod:`eventgpt_tpu.parallel.mesh`     — logical ``Mesh(data, fsdp, context, model)``
+  * :mod:`eventgpt_tpu.parallel.sharding` — PartitionSpec trees for every param pytree
+  * :mod:`eventgpt_tpu.parallel.dist`     — multi-host bootstrap (NCCL/MPI analog)
+  * :mod:`eventgpt_tpu.parallel.ring`     — ring attention over the ``context``
+    axis (planned; the ``context`` mesh axis is reserved for it)
+"""
+
+from eventgpt_tpu.parallel.mesh import make_mesh, best_mesh_config  # noqa: F401
+from eventgpt_tpu.parallel.sharding import (  # noqa: F401
+    eventchat_param_specs,
+    llama_param_specs,
+    clip_param_specs,
+    projector_param_specs,
+    shard_params,
+    batch_spec,
+    kv_cache_specs,
+)

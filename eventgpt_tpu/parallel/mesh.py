@@ -1,0 +1,81 @@
+"""Logical device mesh construction.
+
+Axes (SURVEY.md §2.4 "TPU-native plan"):
+
+  * ``data``    — pure data parallelism; gradients psum over this axis.
+  * ``fsdp``    — ZeRO-style parameter sharding; params all-gathered at use.
+  * ``context`` — sequence/context parallelism (ring attention); 1 for the
+                  parity workloads (reference caps context at 2048,
+                  ``model/EventChatModel.py:378``) but first-class so long
+                  context needs no re-plumbing.
+  * ``model``   — tensor parallelism over attention heads / MLP columns.
+
+Mesh axis order is chosen so that ``model`` (the most communication-hungry
+axis) maps to the innermost / fastest ICI ring on real TPU topologies via
+``mesh_utils.create_device_mesh``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from eventgpt_tpu.config import MeshConfig
+
+AXES = ("data", "fsdp", "context", "model")
+
+
+def make_mesh(cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a ``Mesh`` with logical axes (data, fsdp, context, model).
+
+    ``devices`` defaults to all visible devices; the product of the axis
+    sizes must equal the device count.
+    """
+    shape = (cfg.data, cfg.fsdp, cfg.context, cfg.model)
+    if devices is None:
+        n = jax.device_count()
+        if int(np.prod(shape)) != n:
+            raise ValueError(f"mesh {dict(zip(AXES, shape))} needs {np.prod(shape)} "
+                             f"devices, have {n}")
+        try:
+            dev_array = mesh_utils.create_device_mesh(shape)
+        except Exception:
+            dev_array = np.asarray(jax.devices()).reshape(shape)
+    else:
+        devices = list(devices)
+        if int(np.prod(shape)) != len(devices):
+            raise ValueError(f"mesh {dict(zip(AXES, shape))} needs {np.prod(shape)} "
+                             f"devices, got {len(devices)}")
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    """1x1x1x1 mesh on the first device — lets every pjit path run unsharded."""
+    return make_mesh(MeshConfig(), devices=jax.devices()[:1])
+
+
+def best_mesh_config(
+    n_devices: int,
+    *,
+    fsdp_pref: int = 8,
+    model: int = 1,
+    context: int = 1,
+) -> MeshConfig:
+    """Heuristic mesh for ``n_devices``: fill ``fsdp`` up to ``fsdp_pref``,
+    rest goes to ``data``. Matches the BASELINE.json scale points (8 -> 256
+    chips: fsdp within a host/slice ring, data across)."""
+    inner = model * context
+    if n_devices % inner:
+        raise ValueError(f"{n_devices} devices not divisible by model*context={inner}")
+    rest = n_devices // inner
+    fsdp = 1
+    for cand in range(min(fsdp_pref, rest), 0, -1):
+        if rest % cand == 0:
+            fsdp = cand
+            break
+    return MeshConfig(data=rest // fsdp, fsdp=fsdp, model=model, context=context)

@@ -1,0 +1,145 @@
+"""PartitionSpec trees for every parameter pytree in the framework.
+
+The layout rules (standard megatron-style TP composed with FSDP, per the
+scaling-book recipe — pick a mesh, annotate shardings, let XLA insert the
+collectives):
+
+  * Contracting/input feature dims shard over ``fsdp`` (all-gather at use —
+    ZeRO-3 semantics, the TPU replacement for DeepSpeed in
+    ``requirements.txt:21``).
+  * Head/column dims shard over ``model`` (tensor parallel): q/k/v and MLP
+    up/gate shard their *output* columns, o/down shard their *input* rows,
+    so each layer needs exactly one psum on its output — inserted by XLA.
+  * Stacked-layer leading axes are never sharded (they are scanned over).
+  * Small params (norms, biases, projector) replicate over model and shard
+    nothing — they are noise next to the matmul weights.
+
+Batch dims of activations shard over ``(data, fsdp)`` — fsdp acts as extra
+data parallelism for activations, which is what makes it ZeRO rather than
+tensor parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Specs = Dict[str, Any]
+
+BATCH_AXES = ("data", "fsdp")
+
+
+def batch_spec(ndim: int, seq_axis: Optional[int] = None) -> P:
+    """Activations: batch over (data, fsdp); optional sequence over context."""
+    parts = [BATCH_AXES] + [None] * (ndim - 1)
+    if seq_axis is not None:
+        parts[seq_axis] = "context"
+    return P(*parts)
+
+
+def llama_param_specs() -> Specs:
+    """Mirrors ``models/llama.py:init_llama_params`` structure exactly."""
+    return {
+        # (V, D): vocab over model (TP embed/unembed), features over fsdp.
+        "embed_tokens": P("model", "fsdp"),
+        "layers": {
+            "input_norm": P(None, None),
+            "attn": {
+                "q": P(None, "fsdp", "model"),   # (L, D, QD)
+                "k": P(None, "fsdp", "model"),   # (L, D, KVD)
+                "v": P(None, "fsdp", "model"),   # (L, D, KVD)
+                "o": P(None, "model", "fsdp"),   # (L, QD, D)
+            },
+            "post_norm": P(None, None),
+            "mlp": {
+                "gate": P(None, "fsdp", "model"),  # (L, D, I)
+                "up": P(None, "fsdp", "model"),    # (L, D, I)
+                "down": P(None, "model", "fsdp"),  # (L, I, D)
+            },
+        },
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "model"),  # (D, V)
+    }
+
+
+def clip_param_specs() -> Specs:
+    """Mirrors ``models/clip.py:init_clip_params``. The tower is frozen and
+    small next to the LM; shard the big matmuls, replicate the rest."""
+    ln = {"scale": P(None, None), "bias": P(None, None)}
+
+    def lin(spec_k):
+        return {"kernel": spec_k, "bias": P(None, None)}
+
+    return {
+        "embeddings": {
+            "class_embedding": P(None),
+            "patch_embedding": P("fsdp", None),          # (patch_dim, D)
+            "position_embedding": P(None, "fsdp"),       # (N, D)
+        },
+        "pre_layernorm": {"scale": P(None), "bias": P(None)},
+        "layers": {
+            "ln1": ln,
+            "attn": {
+                "q": lin(P(None, "fsdp", "model")),
+                "k": lin(P(None, "fsdp", "model")),
+                "v": lin(P(None, "fsdp", "model")),
+                "o": lin(P(None, "model", "fsdp")),
+            },
+            "ln2": ln,
+            "mlp": {
+                "fc1": {"kernel": P(None, "fsdp", "model"), "bias": P(None, "model")},
+                "fc2": {"kernel": P(None, "model", "fsdp"), "bias": P(None, None)},
+            },
+        },
+        "post_layernorm": {"scale": P(None), "bias": P(None)},
+    }
+
+
+def projector_param_specs(use_feature_adaptor: bool = True, mlp_depth: int = 2) -> Specs:
+    """Projector MLP + adaptor (model/EventChatModel.py:87-93,75-76): a few
+    4096x4096 matrices — shard rows over fsdp, replicate over model."""
+    lin = {"kernel": P("fsdp", None), "bias": P(None)}
+    specs: Specs = {"mlp": [dict(lin) for _ in range(mlp_depth)]}
+    if use_feature_adaptor:
+        specs["adaptor"] = dict(lin)
+    return specs
+
+
+def eventchat_param_specs(use_feature_adaptor: bool = True, mlp_depth: int = 2) -> Specs:
+    return {
+        "clip": clip_param_specs(),
+        "projector": projector_param_specs(use_feature_adaptor, mlp_depth),
+        "llama": llama_param_specs(),
+    }
+
+
+def kv_cache_specs() -> Specs:
+    """KV cache (L, B, S, KV, hd): batch over (data, fsdp), heads over model."""
+    return {
+        "k": P(None, BATCH_AXES, None, "model", None),
+        "v": P(None, BATCH_AXES, None, "model", None),
+        "length": P(BATCH_AXES),
+    }
+
+
+def tree_shardings(specs, mesh: Mesh):
+    """Specs pytree -> NamedSharding pytree (same structure)."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params, specs, mesh: Mesh):
+    """Place a param pytree onto the mesh according to its spec tree.
+
+    The spec tree must mirror the param tree's structure; a mismatch
+    surfaces as a tree_map structure error here rather than deep in pjit.
+    """
+    shardings = tree_shardings(specs, mesh)
+    return jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, s), params, shardings
+    )

@@ -1,0 +1,105 @@
+"""Mesh + sharding tests on the 8-device virtual CPU mesh (conftest.py).
+
+This is the TPU analog of multi-node simulation (SURVEY.md §4): the same
+pjit programs that run on a v5e slice execute here over 8 host devices.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgpt_tpu.config import EventChatConfig, MeshConfig
+from eventgpt_tpu.models import eventchat, llama as llama_mod
+from eventgpt_tpu.parallel import (
+    batch_spec,
+    best_mesh_config,
+    eventchat_param_specs,
+    make_mesh,
+    shard_params,
+)
+from eventgpt_tpu.parallel.sharding import tree_shardings
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_mesh_axes_and_sizes():
+    mesh = make_mesh(MeshConfig(data=2, fsdp=2, model=2))
+    assert mesh.axis_names == ("data", "fsdp", "context", "model")
+    assert mesh.devices.size == 8
+
+
+def test_best_mesh_config():
+    assert best_mesh_config(8) == MeshConfig(data=1, fsdp=8)
+    assert best_mesh_config(256) == MeshConfig(data=32, fsdp=8)
+    assert best_mesh_config(8, model=2) == MeshConfig(data=1, fsdp=4, model=2)
+
+
+def test_spec_tree_matches_param_tree(tiny_setup):
+    cfg, params = tiny_setup
+    specs = eventchat_param_specs(
+        cfg.projector.use_feature_adaptor, cfg.projector.mlp_depth
+    )
+    p_struct = jax.tree_util.tree_structure(params)
+    from jax.sharding import PartitionSpec as P
+
+    s_struct = jax.tree_util.tree_structure(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    assert p_struct == s_struct
+
+
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(data=2, fsdp=2, model=2),
+    MeshConfig(data=1, fsdp=4, model=2),
+    MeshConfig(data=8),
+])
+def test_sharded_forward_matches_unsharded(tiny_setup, mesh_cfg):
+    cfg, params = tiny_setup
+    mesh = make_mesh(mesh_cfg)
+    specs = eventchat_param_specs(
+        cfg.projector.use_feature_adaptor, cfg.projector.mlp_depth
+    )
+    sharded = shard_params(params, specs, mesh)
+
+    b, t = 8, 16
+    rng = np.random.default_rng(0)
+    embeds = jnp.asarray(rng.normal(size=(b, t, cfg.llama.hidden_size)), jnp.float32)
+    mask = jnp.ones((b, t), bool)
+
+    ref = llama_mod.forward(params["llama"], cfg.llama, embeds, mask)
+
+    in_shard = tree_shardings(specs["llama"], mesh)
+    from jax.sharding import NamedSharding
+
+    fwd = jax.jit(
+        lambda p, e, m: llama_mod.forward(p, cfg.llama, e, m),
+        in_shardings=(in_shard,
+                      NamedSharding(mesh, batch_spec(3)),
+                      NamedSharding(mesh, batch_spec(2))),
+    )
+    out = fwd(sharded["llama"], embeds, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-3)
+
+
+def test_sharded_encode_events(tiny_setup):
+    cfg, params = tiny_setup
+    mesh = make_mesh(MeshConfig(data=2, fsdp=2, model=2))
+    specs = eventchat_param_specs(
+        cfg.projector.use_feature_adaptor, cfg.projector.mlp_depth
+    )
+    sharded = shard_params(params, specs, mesh)
+    pv = jnp.asarray(
+        np.random.default_rng(1).normal(
+            size=(8, cfg.num_event_frames, 3, cfg.vision.image_size, cfg.vision.image_size)
+        ),
+        jnp.float32,
+    )
+    ref = eventchat.encode_events_batch(params, cfg, pv)
+    out = eventchat.encode_events_batch(sharded, cfg, pv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-3)
