@@ -1,0 +1,132 @@
+"""Headline benchmark: 7B decode throughput (tokens/sec/chip) on sample1.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no performance numbers (SURVEY.md §6); per
+BASELINE.json the north-star metric is tokens/sec/chip for 7B decode on the
+reference samples. The first recorded run (bench_baseline.json, committed)
+is the baseline later rounds are compared against.
+
+Model weights are zero-initialized (throughput is data-independent for the
+matmul-bound decode loop); the input path is the REAL sample1.npy host
+pipeline (raster -> CLIP preprocess) plus prefill, so the measured loop is
+the same one a checkpoint would run.
+
+Flags: --preset {auto,7b,tiny}  --decode_tokens N  --batch N
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="auto", choices=["auto", "7b", "tiny"])
+    p.add_argument("--decode_tokens", type=int, default=64)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--warmup", type=int, default=8)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    platform = jax.devices()[0].platform
+    preset = args.preset
+    if preset == "auto":
+        preset = "7b" if platform == "tpu" else "tiny"
+
+    from eventgpt_tpu.config import EventChatConfig
+    from eventgpt_tpu.models import eventchat, llama as llama_mod
+
+    cfg = EventChatConfig.eventgpt_7b() if preset == "7b" else EventChatConfig.tiny()
+    dtype = jnp.bfloat16
+
+    shapes = jax.eval_shape(
+        lambda k: eventchat.init_eventchat_params(cfg, k, dtype), jax.random.PRNGKey(0)
+    )
+    params = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    # Real host preprocessing on the reference fixture when present.
+    sample = "/root/reference/samples/sample1.npy"
+    if os.path.exists(sample) and preset == "7b":
+        from eventgpt_tpu.ops.image import process_event_file
+
+        _, pixels = process_event_file(sample, cfg.num_event_frames, cfg.vision.image_size)
+    else:
+        pixels = np.zeros(
+            (cfg.num_event_frames, 3, cfg.vision.image_size, cfg.vision.image_size),
+            np.float32,
+        )
+    pixels_b = jnp.asarray(np.stack([pixels] * args.batch), dtype)
+
+    # Prompt skeleton: BOS + 34 text ids + event block + 16 text ids.
+    prompt_len = 35 + cfg.num_event_tokens + 16
+    ids = [1] + [7] * 34 + [-200] + [9] * 16
+
+    def sync(x):
+        # A host readback is the only reliable fence on every platform here
+        # (the axon tunnel's block_until_ready returns before compute ends).
+        return float(jnp.sum(x.astype(jnp.float32)))
+
+    t0 = time.perf_counter()
+    ev = eventchat.encode_events_batch(params, cfg, pixels_b)
+    sync(ev)
+    t_encode = time.perf_counter() - t0
+
+    from eventgpt_tpu.data.tokenizer import split_at_event
+    from eventgpt_tpu.models.eventchat import _decode_jit, _pad_batch, _prefill_jit, splice_embeddings
+
+    embeds = [
+        splice_embeddings(params, cfg, split_at_event(ids), ev[i])
+        for i in range(args.batch)
+    ]
+    padded, mask, lens = _pad_batch(embeds)
+    cache_len = ((prompt_len + args.decode_tokens + args.warmup + 127) // 128) * 128
+    cache = llama_mod.init_kv_cache(cfg.llama, args.batch, cache_len, dtype)
+
+    t0 = time.perf_counter()
+    logits, cache = _prefill_jit(params, cfg, padded, mask, cache)
+    sync(logits)
+    t_prefill = time.perf_counter() - t0
+
+    tok = jnp.zeros((args.batch,), jnp.int32)
+    for _ in range(args.warmup):  # warmup compiles + stabilizes clocks
+        logits_d, cache = _decode_jit(params, cfg, tok, cache)
+    sync(logits_d)
+
+    t0 = time.perf_counter()
+    for _ in range(args.decode_tokens):
+        logits_d, cache = _decode_jit(params, cfg, tok, cache)
+    sync(logits_d)
+    dt = time.perf_counter() - t0
+
+    toks_per_s = args.decode_tokens * args.batch / dt
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+    record = {
+        "metric": f"tokens_per_sec_per_chip_{preset}_decode",
+        "value": round(toks_per_s, 2),
+        "unit": "tok/s",
+    }
+    vs = 1.0
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            base = json.load(f)
+        if base.get("metric") == record["metric"] and base.get("value"):
+            vs = round(toks_per_s / base["value"], 3)
+    else:
+        with open(baseline_path, "w") as f:
+            json.dump({**record, "platform": platform,
+                       "encode_s": round(t_encode, 3), "prefill_s": round(t_prefill, 3)}, f)
+    record["vs_baseline"] = vs
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Checkpoint / resume: orbax for full sharded state, npz for components.
+
+The reference's persistence story is HF ``from_pretrained`` plus raw
+``torch.load`` partial checkpoints with key-prefix rewriting for the small
+vision modules (``model/EventChatModel.py:124-163``, SURVEY.md §5
+"Checkpoint / resume"); optimizer-state resume lived off-tree in DeepSpeed.
+The TPU-native equivalent:
+
+  * **Full checkpoints** (params, optimizer state, step) via orbax —
+    sharded-array aware, multi-host safe, atomic.
+  * **Component checkpoints** (projector / feature adaptor) as plain npz —
+    small, portable artifacts mirroring the reference's stage-1 outputs, with
+    the same prefix-rewrite semantics on load.
+  * **HF import** lives in ``models/convert.py``; this module persists the
+    converted trees so torch never enters the hot path again.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+Params = Dict[str, Any]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(path: str, tree: Any) -> None:
+    """Atomically save a pytree (params / TrainState fields) to ``path``."""
+    ckptr = _checkpointer()
+    ckptr.save(os.path.abspath(path), tree, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_checkpoint(path: str, target: Optional[Any] = None) -> Any:
+    """Restore a pytree. With ``target`` (a tree of like-shaped arrays —
+    e.g. ``jax.eval_shape`` output placed on a mesh), arrays restore directly
+    into the target's shardings; without it, arrays restore unsharded."""
+    ckptr = _checkpointer()
+    if target is None:
+        return ckptr.restore(os.path.abspath(path))
+    # Abstract target (shape/dtype/sharding skeleton) drives sharded restore.
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+        if hasattr(x, "shape") else x,
+        target,
+    )
+    return ckptr.restore(os.path.abspath(path), abstract)
+
+
+# ---------------------------------------------------------------------------
+# Component (partial) checkpoints — stage-1 artifacts
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}." if not isinstance(v, (np.ndarray, jax.Array)) else f"{prefix}{k}"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{i}." if not isinstance(v, (np.ndarray, jax.Array)) else f"{prefix}{i}"))
+    else:
+        out[prefix.rstrip(".")] = np.asarray(tree)
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Any:
+    tree: Dict[str, Any] = {}
+    for key, val in flat.items():
+        parts = key.split(".")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+
+    def listify(node):
+        if not isinstance(node, dict):
+            return node
+        keys = list(node.keys())
+        if keys and all(k.isdigit() for k in keys):
+            return [listify(node[str(i)]) for i in range(len(keys))]
+        return {k: listify(v) for k, v in node.items()}
+
+    return listify(tree)
+
+
+def save_component(path: str, tree: Params, prefix: str = "") -> None:
+    """Save a small module subtree (e.g. the projector) as one npz file.
+
+    ``prefix`` is prepended to every key — the write-side analog of the
+    reference's ``model.visual_projector.``-style prefixes.
+    """
+    flat = {prefix + k: v for k, v in _flatten(tree).items()}
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    np.savez(path, **flat)
+
+
+def load_component(path: str, strip_prefix: str = "") -> Params:
+    """Load an npz component, rewriting keys by stripping ``strip_prefix`` —
+    the semantics of the reference's partial ``torch.load`` +
+    ``startswith/replace`` key surgery (``model/EventChatModel.py:124-139``)."""
+    with np.load(path) as data:
+        flat = {}
+        for k in data.files:
+            key = k[len(strip_prefix):] if strip_prefix and k.startswith(strip_prefix) else k
+            flat[key] = data[k]
+    return _unflatten(flat)

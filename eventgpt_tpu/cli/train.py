@@ -1,0 +1,85 @@
+"""Training CLI: the in-tree replacement for the external LLaVA launch.
+
+Flags mirror the recovered ModelArguments / DataArguments / TrainingArguments
+(SURVEY.md §2.2) via dataclass reflection — every field is a ``--flag``.
+
+Usage (projector warm-up on a toy dataset):
+  python -m eventgpt_tpu.cli.train --model_name_or_path tiny-random \\
+      --data_path data.json --event_folder samples/ --stage 1 --max_steps 20
+
+Stage 2 (LoRA):  add ``--stage 2 --lora_r 64 --lora_alpha 16``.
+Multi-host:      run one process per host with EGPT_COORDINATOR /
+                 EGPT_NUM_PROCESSES / EGPT_PROCESS_ID set (parallel/dist.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+from typing import Optional, get_args, get_origin
+
+import jax
+
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.parallel.dist import initialize_distributed
+from eventgpt_tpu.train.args import DataArguments, ModelArguments, TrainingArguments
+from eventgpt_tpu.train.trainer import Trainer
+
+
+def _add_dataclass_args(parser: argparse.ArgumentParser, cls) -> None:
+    for f in dataclasses.fields(cls):
+        tp = f.type if not isinstance(f.type, str) else eval(f.type)  # noqa: S307
+        if get_origin(tp) is not None:  # Optional[X] -> X
+            inner = [a for a in get_args(tp) if a is not type(None)]
+            tp = inner[0] if inner else str
+        if tp is bool:
+            parser.add_argument(
+                f"--{f.name}", type=lambda v: v.lower() in ("true", "1", "yes"),
+                default=f.default,
+            )
+        else:
+            parser.add_argument(f"--{f.name}", type=tp, default=f.default)
+
+
+def _extract(args: argparse.Namespace, cls):
+    return cls(**{f.name: getattr(args, f.name) for f in dataclasses.fields(cls)})
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="EventGPT-TPU trainer")
+    for cls in (ModelArguments, DataArguments, TrainingArguments):
+        _add_dataclass_args(parser, cls)
+    parser.add_argument("--resume_from", type=str, default=None)
+    args = parser.parse_args(argv)
+
+    initialize_distributed()
+
+    margs = _extract(args, ModelArguments)
+    dargs = _extract(args, DataArguments)
+    targs = _extract(args, TrainingArguments)
+
+    from eventgpt_tpu.cli.infer import load_model
+
+    cfg, params, tokenizer = load_model(
+        margs.model_name_or_path, "bfloat16" if targs.bf16 else "float32"
+    )
+
+    if margs.pretrain_mm_mlp_adapter:
+        from eventgpt_tpu import checkpoint as ckpt
+
+        params["projector"] = ckpt.load_component(
+            margs.pretrain_mm_mlp_adapter, strip_prefix="model.visual_projector."
+        )
+
+    trainer = Trainer(cfg, params, tokenizer, margs, dargs, targs)
+    if args.resume_from:
+        trainer.resume(args.resume_from)
+    metrics = trainer.train()
+    print(metrics)
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -83,7 +83,7 @@ class LlamaConfig:
         """Small config for tests / CPU-mesh dry runs."""
         return LlamaConfig(
             vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
-            num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=256,
         )
 
 
@@ -235,6 +235,7 @@ def from_hf_config(hf: dict) -> EventChatConfig:
     return EventChatConfig(
         llama=llama,
         projector=proj,
+        use_spatio_temporal_pool=hf.get("spatial_temporal_encoder", True),
         mm_use_im_start_end=hf.get("mm_use_im_start_end", False),
         mm_use_im_patch_token=hf.get("mm_use_im_patch_token", True),
     )

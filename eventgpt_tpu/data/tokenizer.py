@@ -82,10 +82,14 @@ class ByteTokenizer:
         self.bos_token_id = 1
         self.eos_token_id = 2
         self._byte_offset = 3
-        self._special: dict[str, int] = {}
+        # Literal "<s>"/"</s>" in text map to the real BOS/EOS ids, the
+        # behavior LLaVA-style prompt assembly relies on from sentencepiece.
+        self._special: dict[str, int] = {"<s>": 1, "</s>": 2}
+
+    _NUM_RESERVED_SPECIAL = 2  # <s>, </s> map into the base vocab
 
     def __len__(self) -> int:
-        return 259 + len(self._special)
+        return 259 + len(self._special) - self._NUM_RESERVED_SPECIAL
 
     def add_tokens(self, tokens: Sequence[str], special_tokens: bool = True) -> int:
         added = 0
@@ -110,8 +114,11 @@ class ByteTokenizer:
                 i += 1
         return ids
 
-    def __call__(self, text: str):
-        return {"input_ids": [self.bos_token_id] + self._encode_text(text)}
+    def __call__(self, text: str, add_special_tokens: bool = True):
+        ids = self._encode_text(text)
+        if add_special_tokens:
+            ids = [self.bos_token_id] + ids
+        return {"input_ids": ids}
 
     def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
         inv = {v: k for k, v in self._special.items()}

@@ -104,6 +104,20 @@ def splice_embeddings(
             parts.append(event_tokens[i].astype(embed_dtype))
     out = jnp.concatenate(parts, axis=0)
     limit = cfg.llama.max_seq_len if max_context is None else min(cfg.llama.max_seq_len, max_context)
+    if out.shape[0] > limit:
+        # Text overflow truncates silently (reference parity, model/
+        # EventChatModel.py:378-381) — but cutting into an event block would
+        # silently destroy the visual input, so that fails loudly instead
+        # (e.g. non-pool mode: 5*577 event tokens vs a 2048 context).
+        n_text = sum(len(s) for s in segments)
+        last_event_end = out.shape[0] - len(segments[-1])
+        if num_events and last_event_end > limit:
+            raise ValueError(
+                f"spliced sequence ({out.shape[0]} tokens: {n_text} text + "
+                f"{num_events}x{event_tokens.shape[1]} event) exceeds the "
+                f"context cap {limit} inside an event block; raise "
+                f"max_seq_len/--context_len or enable spatio-temporal pooling"
+            )
     return out[:limit]
 
 

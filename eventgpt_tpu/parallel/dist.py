@@ -58,6 +58,14 @@ def initialize_distributed(
         for v in ("TPU_WORKER_HOSTNAMES", "TPU_SKYLARK_HOSTS", "MEGASCALE_COORDINATOR_ADDRESS")
     )
     if not explicit and not autodetectable:
+        if num_processes is not None or process_id is not None:
+            # Half-configured launch: running on silently would give N
+            # independent single-process trainers all claiming primary.
+            raise ValueError(
+                "EGPT_NUM_PROCESSES/EGPT_PROCESS_ID are set but "
+                "EGPT_COORDINATOR is not; refusing to fall back to a "
+                "single-process run"
+            )
         log.info("single-process run; skipping jax.distributed.initialize")
         return False
 

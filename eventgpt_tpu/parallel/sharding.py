@@ -75,7 +75,9 @@ def clip_param_specs() -> Specs:
     return {
         "embeddings": {
             "class_embedding": P(None),
-            "patch_embedding": P("fsdp", None),          # (patch_dim, D)
+            # (patch_dim, D): patch_dim = 3*14*14 = 588 has awkward factors;
+            # shard the output features instead.
+            "patch_embedding": P(None, "fsdp"),
             "position_embedding": P(None, "fsdp"),       # (N, D)
         },
         "pre_layernorm": {"scale": P(None), "bias": P(None)},

@@ -1,0 +1,19 @@
+"""Training subsystem: data pipeline, optimizers, LoRA, train steps, driver.
+
+The reference shipped no trainer — it relied on LLaVA's HF Trainer +
+DeepSpeed + NCCL, with its data module surviving only as bytecode
+(SURVEY.md §0.1, §2.2). This package re-creates that training path natively:
+
+  * :mod:`eventgpt_tpu.train.data`    — EventChatDataset + fixed-layout collator
+  * :mod:`eventgpt_tpu.train.optim`   — LR schedules + AdamW with param groups
+  * :mod:`eventgpt_tpu.train.lora`    — LoRA adapters over the stacked LLaMA tree
+  * :mod:`eventgpt_tpu.train.steps`   — jitted stage-1/stage-2 train steps
+  * :mod:`eventgpt_tpu.train.trainer` — epoch/step driver with metrics
+"""
+
+from eventgpt_tpu.train.optim import (  # noqa: F401
+    linear_warmup_cosine,
+    step_decay,
+    make_optimizer,
+)
+from eventgpt_tpu.train.lora import init_lora_params, merge_lora  # noqa: F401

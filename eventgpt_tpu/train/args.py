@@ -1,0 +1,74 @@
+"""Training argument dataclasses — parity with the recovered pyc dataclasses.
+
+Field-for-field re-creation of ``ModelArguments`` / ``DataArguments`` /
+``TrainingArguments`` from ``IeTdataset_transformers.cpython-310.pyc``
+(SURVEY.md §2.2), minus GPU-specific knobs that have no TPU meaning
+(``bits/double_quant/quant_type`` nf4 quantization, ``mpt_attn_impl``),
+which are accepted-but-rejected so old launch scripts fail loudly rather
+than silently.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ModelArguments:
+    model_name_or_path: str = "tiny-random"
+    freeze_backbone: bool = False
+    tune_mm_mlp_adapter: bool = False
+    vision_tower: Optional[str] = None
+    mm_vision_select_layer: int = -1
+    pretrain_mm_mlp_adapter: Optional[str] = None
+    mm_projector_type: str = "linear"
+    mm_use_im_start_end: bool = False
+    mm_use_im_patch_token: bool = True
+    mm_vision_select_feature: str = "patch"
+
+
+@dataclass
+class DataArguments:
+    data_path: str = ""
+    lazy_preprocess: bool = True
+    is_multimodal: bool = True
+    event_folder: str = ""
+    image_aspect_ratio: str = "square"
+    conv_version: str = "v1"
+
+
+@dataclass
+class TrainingArguments:
+    output_dir: str = "./output"
+    stage: int = 1                      # 1 = projector warm-up, 2 = LoRA finetune
+    num_train_epochs: int = 1
+    max_steps: int = -1
+    per_device_train_batch_size: int = 4
+    gradient_accumulation_steps: int = 1
+    learning_rate: float = 2e-3
+    min_lr: float = 0.0
+    warmup_steps: int = 0
+    warmup_ratio: float = 0.03
+    weight_decay: float = 0.0
+    max_grad_norm: float = 1.0
+    model_max_length: int = 2048
+    seed: int = 0
+    logging_steps: int = 10
+    save_steps: int = 500
+    group_by_modality_length: bool = False
+    freeze_mm_mlp_adapter: bool = False
+    mm_projector_lr: Optional[float] = None
+    bf16: bool = True
+    # LoRA (stage 2)
+    lora_enable: bool = False
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    lora_dropout: float = 0.0
+    lora_weight_path: str = ""
+    lora_bias: str = "none"
+    # Mesh
+    mesh_data: int = -1                 # -1 -> auto (best_mesh_config)
+    mesh_fsdp: int = -1
+    mesh_model: int = 1
+    mesh_context: int = 1

@@ -1,0 +1,128 @@
+"""LoRA adapters over the stacked-layer LLaMA parameter tree.
+
+Stage 2 of the reference recipe LoRA-finetunes the LLM (peft import at
+``model/EventChatModel.py:8``; ``lora_r/lora_alpha/lora_dropout/lora_bias``
+in the recovered TrainingArguments, SURVEY.md §2.2). The TPU-native design
+keeps LoRA as a *separate trainable pytree* whose A/B factors are stacked on
+the layer axis — exactly like the base params — and merges them into the
+frozen base weights inside the jitted step:
+
+    W_eff = W + (alpha / r) * A @ B      (einsum over the stacked layer axis)
+
+Merging inside jit keeps the base weights frozen (no gradient flows to them:
+they enter only as constants) while XLA fuses the rank-r update into the
+surrounding matmuls. This replaces peft's module-wrapping with two einsums.
+
+Note: merge-form LoRA cannot express per-call input dropout; ``lora_dropout``
+is accepted for config parity but must be 0 here (the reference's inference
+path also runs with dropout disabled).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from eventgpt_tpu.config import LlamaConfig
+
+Params = Dict[str, Any]
+
+# (group, name) -> (in_dim_attr, out_dim_attr) resolved against LlamaConfig.
+_TARGET_SHAPES = {
+    ("attn", "q"): lambda c: (c.hidden_size, c.num_heads * c.resolved_head_dim()),
+    ("attn", "k"): lambda c: (c.hidden_size, c.num_kv_heads * c.resolved_head_dim()),
+    ("attn", "v"): lambda c: (c.hidden_size, c.num_kv_heads * c.resolved_head_dim()),
+    ("attn", "o"): lambda c: (c.num_heads * c.resolved_head_dim(), c.hidden_size),
+    ("mlp", "gate"): lambda c: (c.hidden_size, c.intermediate_size),
+    ("mlp", "up"): lambda c: (c.hidden_size, c.intermediate_size),
+    ("mlp", "down"): lambda c: (c.intermediate_size, c.hidden_size),
+}
+
+DEFAULT_TARGETS: Tuple[str, ...] = ("q", "k", "v", "o", "gate", "up", "down")
+
+
+@dataclass(frozen=True)
+class LoraConfig:
+    """Defaults follow the recovered TrainingArguments (SURVEY.md §2.2) /
+    peft conventions: r=64, alpha=16, dropout accepted-but-zero."""
+
+    r: int = 64
+    alpha: float = 16.0
+    dropout: float = 0.0
+    targets: Tuple[str, ...] = DEFAULT_TARGETS
+
+    def __post_init__(self):
+        if self.dropout != 0.0:
+            raise NotImplementedError(
+                "merge-form LoRA runs with dropout=0; see module docstring"
+            )
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.r
+
+
+def init_lora_params(
+    cfg: LlamaConfig, lora: LoraConfig, key: jax.Array, dtype=jnp.float32
+) -> Params:
+    """A ~ Kaiming-uniform, B = 0 (peft init): the adapted model starts
+    exactly equal to the base model."""
+    out: Params = {"attn": {}, "mlp": {}}
+    keys = jax.random.split(key, len(_TARGET_SHAPES))
+    for i, ((group, name), dims) in enumerate(_TARGET_SHAPES.items()):
+        if name not in lora.targets:
+            continue
+        d_in, d_out = dims(cfg)
+        bound = 1.0 / math.sqrt(d_in)
+        out[group][name] = {
+            "a": jax.random.uniform(
+                keys[i], (cfg.num_layers, d_in, lora.r), dtype, -bound, bound
+            ),
+            "b": jnp.zeros((cfg.num_layers, lora.r, d_out), dtype),
+        }
+    return out
+
+
+def merge_lora(base_llama: Params, lora_params: Params, lora: LoraConfig) -> Params:
+    """Frozen base + trainable LoRA -> effective LLaMA params (same tree).
+
+    Gradients w.r.t. ``lora_params`` flow through the einsum; the base tree
+    is untouched (callers pass it as a non-differentiated argument).
+    """
+    scale = lora.scaling
+    layers = base_llama["layers"]
+    new_layers = {**layers}
+    for group in ("attn", "mlp"):
+        if group not in lora_params or not lora_params[group]:
+            continue
+        new_group = {**layers[group]}
+        for name, ab in lora_params[group].items():
+            delta = jnp.einsum(
+                "ldr,lro->ldo", ab["a"], ab["b"],
+                preferred_element_type=ab["a"].dtype,
+            )
+            new_group[name] = layers[group][name] + scale * delta.astype(
+                layers[group][name].dtype
+            )
+        new_layers[group] = new_group
+    return {**base_llama, "layers": new_layers}
+
+
+def lora_param_specs(targets: Sequence[str] = DEFAULT_TARGETS) -> Params:
+    """PartitionSpecs for the LoRA tree: rank dim replicated, feature dims
+    following the base layout (fsdp on input rows, model on output cols)."""
+    from jax.sharding import PartitionSpec as P
+
+    spec_in = {"a": P(None, "fsdp", None), "b": P(None, None, "model")}
+    # o/down contract over the model-sharded dim instead.
+    spec_out = {"a": P(None, "model", None), "b": P(None, None, "fsdp")}
+    out: Params = {"attn": {}, "mlp": {}}
+    for (group, name) in _TARGET_SHAPES:
+        if name not in targets:
+            continue
+        out[group][name] = spec_out if name in ("o", "down") else spec_in
+    return out

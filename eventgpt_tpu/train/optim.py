@@ -1,0 +1,99 @@
+"""Optimizers and LR schedules.
+
+Schedule parity with the reference's ``model/common/optim.py``:
+
+  * ``linear_warmup_cosine``  ≡ ``LinearWarmupCosineLRScheduler`` (``:3-40``):
+    linear warmup from ``warmup_start_lr`` to ``init_lr`` over ``warmup_steps``,
+    then per-step cosine decay to ``min_lr``.
+  * ``step_decay``            ≡ ``step_lr_schedule`` (``:52-62``):
+    ``max(init_lr * decay_rate**epoch, min_lr)``.
+
+The optimizer is AdamW (``TrainingArguments.optim='adamw_torch'``, SURVEY.md
+§2.2) with optional gradient clipping, a separate projector LR group
+(``mm_projector_lr``), and gradient accumulation via ``optax.MultiSteps``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import optax
+
+
+def linear_warmup_cosine(
+    init_lr: float,
+    total_steps: int,
+    warmup_steps: int = 0,
+    min_lr: float = 0.0,
+    warmup_start_lr: float = -1.0,
+) -> optax.Schedule:
+    """Linear warmup then cosine decay (reference ``optim.py:3-50``).
+
+    ``warmup_start_lr < 0`` means "start at init_lr" (the reference's
+    sentinel default at ``optim.py:21``).
+    """
+    start = init_lr if warmup_start_lr < 0 else warmup_start_lr
+    if warmup_steps > 0:
+        warmup = optax.linear_schedule(start, init_lr, warmup_steps)
+    else:
+        warmup = optax.constant_schedule(init_lr)
+    cosine = optax.cosine_decay_schedule(
+        init_lr, max(total_steps - warmup_steps, 1), alpha=min_lr / max(init_lr, 1e-12)
+    )
+    return optax.join_schedules([warmup, cosine], [warmup_steps])
+
+
+def step_decay(
+    init_lr: float,
+    min_lr: float,
+    decay_rate: float,
+    steps_per_epoch: int,
+) -> optax.Schedule:
+    """Per-epoch exponential step decay floored at min_lr (``optim.py:52-62``)."""
+
+    def schedule(count):
+        epoch = count // steps_per_epoch
+        import jax.numpy as jnp
+
+        return jnp.maximum(init_lr * decay_rate ** epoch, min_lr)
+
+    return schedule
+
+
+def make_optimizer(
+    schedule: Any,
+    weight_decay: float = 0.0,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    grad_clip: Optional[float] = 1.0,
+    projector_lr: Optional[float] = None,
+    accum_steps: int = 1,
+) -> optax.GradientTransformation:
+    """AdamW over the trainable pytree.
+
+    ``projector_lr`` gives the ``projector`` top-level subtree its own
+    constant LR, mirroring ``mm_projector_lr`` in the recovered
+    TrainingArguments (SURVEY.md §2.2); everything else follows ``schedule``.
+    """
+
+    def adamw(lr):
+        chain = []
+        if grad_clip is not None:
+            chain.append(optax.clip_by_global_norm(grad_clip))
+        chain.append(optax.adamw(lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay))
+        return optax.chain(*chain)
+
+    if projector_lr is None:
+        tx = adamw(schedule)
+    else:
+        def label_fn(tree):
+            return {k: ("projector" if k == "projector" else "base") for k in tree}
+
+        tx = optax.multi_transform(
+            {"base": adamw(schedule), "projector": adamw(projector_lr)},
+            label_fn,
+        )
+    if accum_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=accum_steps)
+    return tx

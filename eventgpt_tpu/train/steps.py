@@ -1,0 +1,204 @@
+"""Jitted training steps for the two-stage recipe.
+
+Stage 1 (projector warm-up): CLIP and the LM are frozen; only the projector
+MLP (+ feature adaptor) trains — the reference implements this by detaching
+the CLIP output and re-enabling grad (``model/EventChatModel.py:185-191``);
+here the boundary is simply which pytree is differentiated.
+
+Stage 2 (LoRA finetune): the LM is adapted through a LoRA tree merged into
+the frozen base weights inside the step (``train/lora.py``); the projector
+keeps training with its own LR group (``mm_projector_lr``).
+
+Both steps consume the fixed-layout batches of ``train/data.py``: the
+embedding splice is a static-shape ``take_along_axis`` + ``where`` — the
+XLA-compilable redesign of ``prepare_inputs_labels_for_multimodal``
+(``model/EventChatModel.py:292-428``).
+
+Sharding: the step functions are plain ``jax.jit``; placement follows the
+input shardings (params via ``parallel.shard_params``, batches via
+``batch_spec``), and XLA inserts the psums over ``data``/``fsdp`` — no
+hand-written collectives (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.constants import IGNORE_INDEX
+from eventgpt_tpu.models import eventchat, llama as llama_mod
+from eventgpt_tpu.train.lora import LoraConfig, merge_lora
+
+Params = Dict[str, Any]
+Batch = Dict[str, jnp.ndarray]
+
+
+def multimodal_embeds(params: Params, cfg: EventChatConfig, batch: Batch) -> jnp.ndarray:
+    """Fixed-layout splice: text embeddings with event tokens gathered in.
+
+    ``event_index[b, t]`` maps each event slot to its row in the pooled
+    event-token block; non-event positions read the text embedding table.
+    """
+    ev = eventchat.encode_events_batch(params, cfg, batch["pixel_values"])  # (B,E,D)
+    txt = llama_mod.embed_tokens(params["llama"], batch["token_ids"])       # (B,T,D)
+    ev = ev.astype(txt.dtype)
+    gathered = jnp.take_along_axis(
+        ev, batch["event_index"][:, :, None].astype(jnp.int32), axis=1
+    )  # (B,T,D)
+    return jnp.where(batch["event_pos"][:, :, None], gathered, txt)
+
+
+def lm_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Next-token CE over non-IGNORE positions. Returns (loss, n_valid)."""
+    shift_logits = logits[:, :-1].astype(jnp.float32)
+    shift_labels = labels[:, 1:]
+    valid = shift_labels != IGNORE_INDEX
+    safe_labels = jnp.where(valid, shift_labels, 0)
+    ll = jax.nn.log_softmax(shift_logits, axis=-1)
+    nll = -jnp.take_along_axis(ll, safe_labels[..., None], axis=-1)[..., 0]
+    n_valid = valid.sum()
+    loss = jnp.where(valid, nll, 0.0).sum() / jnp.maximum(n_valid, 1)
+    return loss, n_valid
+
+
+def _forward_loss(params: Params, cfg: EventChatConfig, batch: Batch) -> jnp.ndarray:
+    embeds = multimodal_embeds(params, cfg, batch)
+    logits = llama_mod.forward(params["llama"], cfg.llama, embeds, batch["attn_mask"])
+    loss, _ = lm_loss(logits, batch["labels"])
+    return loss
+
+
+class TrainState(NamedTuple):
+    trainable: Params     # differentiated pytree (stage-dependent structure)
+    frozen: Params        # non-differentiated base params
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def stage1_combine(trainable: Params, frozen: Params) -> Params:
+    """Trainable = {"projector"}; CLIP + LM frozen."""
+    return {"clip": frozen["clip"], "llama": frozen["llama"],
+            "projector": trainable["projector"]}
+
+
+def make_stage2_combine(lora_cfg: LoraConfig) -> Callable[[Params, Params], Params]:
+    """Trainable = {"projector", "lora"}; base LM enters as constants."""
+
+    def combine(trainable: Params, frozen: Params) -> Params:
+        return {
+            "clip": frozen["clip"],
+            "projector": trainable["projector"],
+            "llama": merge_lora(frozen["llama"], trainable["lora"], lora_cfg),
+        }
+
+    return combine
+
+
+def make_train_step(
+    cfg: EventChatConfig,
+    optimizer: optax.GradientTransformation,
+    combine: Callable[[Params, Params], Params] = stage1_combine,
+    donate: bool = True,
+):
+    """Build the jitted step: (state, batch) -> (state, metrics).
+
+    Gradients flow only into ``state.trainable`` — the frozen tree is a
+    closure-free constant argument, which is the whole freeze mechanism
+    (no requires_grad bookkeeping as in the reference).
+    """
+
+    @functools.partial(
+        jax.jit,
+        static_argnames=(),
+        donate_argnums=(0,) if donate else (),
+    )
+    def step(state: TrainState, batch: Batch):
+        def loss_fn(trainable):
+            params = combine(trainable, state.frozen)
+            return _forward_loss(params, cfg, batch)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.trainable)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.trainable)
+        trainable = optax.apply_updates(state.trainable, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(trainable, state.frozen, opt_state, state.step + 1)
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    return step
+
+
+def make_eval_step(cfg: EventChatConfig, combine: Callable[[Params, Params], Params] = stage1_combine):
+    @jax.jit
+    def step(state: TrainState, batch: Batch):
+        params = combine(state.trainable, state.frozen)
+        embeds = multimodal_embeds(params, cfg, batch)
+        logits = llama_mod.forward(params["llama"], cfg.llama, embeds, batch["attn_mask"])
+        loss, n = lm_loss(logits, batch["labels"])
+        return {"loss": loss, "n_tokens": n}
+
+    return step
+
+
+def init_train_state(
+    trainable: Params,
+    frozen: Params,
+    optimizer: optax.GradientTransformation,
+) -> TrainState:
+    return TrainState(
+        trainable=trainable,
+        frozen=frozen,
+        opt_state=optimizer.init(trainable),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def split_stage1(params: Params) -> Tuple[Params, Params]:
+    """Full param tree -> (trainable, frozen) for stage 1."""
+    return ({"projector": params["projector"]},
+            {"clip": params["clip"], "llama": params["llama"]})
+
+
+def split_stage2(
+    params: Params, cfg: EventChatConfig, lora_cfg: LoraConfig, key: jax.Array,
+    dtype=jnp.float32,
+) -> Tuple[Params, Params]:
+    """Full param tree -> (trainable incl. fresh LoRA, frozen base)."""
+    from eventgpt_tpu.train.lora import init_lora_params
+
+    trainable = {
+        "projector": params["projector"],
+        "lora": init_lora_params(cfg.llama, lora_cfg, key, dtype),
+    }
+    frozen = {"clip": params["clip"], "llama": params["llama"]}
+    return trainable, frozen
+
+
+def batch_to_device(batch: Dict[str, Any], mesh=None) -> Batch:
+    """Host batch -> device, sharded over (data, fsdp) when a mesh is given."""
+    if mesh is None:
+        return {k: jnp.asarray(v) for k, v in batch.items()}
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from eventgpt_tpu.parallel.sharding import batch_spec
+
+    dp = mesh.shape["data"] * mesh.shape["fsdp"]
+    b = next(iter(batch.values())).shape[0]
+    if b % dp:
+        # Batch smaller than / not divisible by the DP extent (tiny smoke
+        # runs): replicate rather than fail. Production batches divide dp.
+        spec_fn = lambda ndim: PartitionSpec()
+    else:
+        spec_fn = batch_spec
+    return {
+        k: jax.device_put(jnp.asarray(v), NamedSharding(mesh, spec_fn(np_ndim(v))))
+        for k, v in batch.items()
+    }
+
+
+def np_ndim(x) -> int:
+    return getattr(x, "ndim", 0)
