@@ -96,6 +96,7 @@ def main() -> None:
     t_prefill = time.perf_counter() - t0
 
     tok = jnp.zeros((args.batch,), jnp.int32)
+    logits_d = logits[:, 0]
     for _ in range(args.warmup):  # warmup compiles + stabilizes clocks
         logits_d, cache = _decode_jit(params, cfg, tok, cache)
     sync(logits_d)

@@ -1,0 +1,80 @@
+"""ctypes bridge to the native toolchain (native/libegpt_native.so).
+
+pybind11 is not in this image, so the C ABI in ``native/src/capi.cpp`` is
+bound with ctypes. The native rasterizer replaces the host hot spot
+(``common/common.py:64-74`` measured at ~132k events/sample) with a single
+linear C pass; the Python numpy scatter fallback stays available everywhere
+the library has not been built.
+
+Build:  cmake -S native -B native/build && cmake --build native/build -j
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_SEARCHED = False
+
+
+def _candidate_paths():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for build in ("build", "build-release", "build-asan"):
+        yield os.path.join(root, "native", build, "libegpt_native.so")
+    env = os.environ.get("EGPT_NATIVE_LIB")
+    if env:
+        yield env
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """Load libegpt_native.so if built; returns None (and remembers) if not."""
+    global _LIB, _SEARCHED
+    if _LIB is not None or _SEARCHED:
+        return _LIB
+    _SEARCHED = True
+    for path in _candidate_paths():
+        if not os.path.exists(path):
+            continue
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            continue
+        lib.egpt_rasterize.argtypes = [
+            ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_uint16),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.egpt_rasterize.restype = None
+        _LIB = lib
+        break
+    return _LIB
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+def rasterize_events_native(
+    x: np.ndarray, y: np.ndarray, p: np.ndarray, height: int, width: int
+) -> np.ndarray:
+    """Native last-write-wins polarity raster; same semantics as
+    ``ops.raster.rasterize_events``. Raises RuntimeError if the lib is absent."""
+    lib = load_library()
+    if lib is None:
+        raise RuntimeError("libegpt_native.so not built; run scripts/build_native.sh")
+    x = np.ascontiguousarray(x, dtype=np.uint16)
+    y = np.ascontiguousarray(y, dtype=np.uint16)
+    p = np.ascontiguousarray(p, dtype=np.uint8)
+    out = np.empty(height * width * 3, dtype=np.uint8)
+    lib.egpt_rasterize(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        p.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        len(x), height, width,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out.reshape(height, width, 3)

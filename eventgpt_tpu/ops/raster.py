@@ -71,6 +71,11 @@ def rasterize_events(
     if width is None:
         width = int(x.max()) + 1
 
+    from eventgpt_tpu import native
+
+    if native.available():
+        return native.rasterize_events_native(x, y, p, height, width)
+
     lin = y.astype(np.int64) * width + x.astype(np.int64)
     last = np.full(height * width, -1, dtype=np.int64)
     np.maximum.at(last, lin, np.arange(lin.size, dtype=np.int64))

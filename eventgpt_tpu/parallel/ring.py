@@ -108,11 +108,16 @@ def ring_self_attention(
     b, s, h, hd = q.shape
     if valid is None:
         valid = jnp.ones((b, s), bool)
+    return _ring_jitted(mesh, causal, axis_name)(q, k, v, valid, valid)
 
+
+@functools.lru_cache(maxsize=32)
+def _ring_jitted(mesh: Mesh, causal: bool, axis_name: str):
+    """One jitted shard_map per (mesh, causal, axis) — rebuilding it per call
+    would retrace and recompile on every invocation."""
     qkv_spec = P(("data", "fsdp"), "context", "model", None)
     valid_spec = P(("data", "fsdp"), "context")
-
-    fn = jax.jit(
+    return jax.jit(
         jax.shard_map(
             functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal),
             mesh=mesh,
@@ -120,7 +125,6 @@ def ring_self_attention(
             out_specs=qkv_spec,
         )
     )
-    return fn(q, k, v, valid, valid)
 
 
 def dense_reference_attention(q, k, v, valid=None, causal=True):

@@ -97,10 +97,39 @@ class Trainer:
                 params, cfg, self.lora_cfg, jax.random.PRNGKey(train_args.seed),
                 dtype=jnp.float32,  # LoRA factors stay f32 for optimizer stability
             )
+            if train_args.lora_weight_path:
+                from eventgpt_tpu import checkpoint as ckpt_mod
+
+                trainable["lora"] = jax.tree_util.tree_map(
+                    lambda x: jnp.asarray(x, jnp.float32),
+                    ckpt_mod.load_component(train_args.lora_weight_path,
+                                            strip_prefix="lora."),
+                )
             trainable_specs = {"projector": proj_specs,
                                "lora": lora_param_specs(self.lora_cfg.targets)}
-            self.combine = steps_mod.make_stage2_combine(self.lora_cfg)
+            if train_args.freeze_mm_mlp_adapter:
+                # Projector stays frozen during stage 2 (freeze_mm_mlp_adapter,
+                # SURVEY.md §2.2): move it to the frozen tree.
+                frozen = {**frozen, "projector": trainable.pop("projector")}
+                frozen_specs = {**frozen_specs, "projector": proj_specs}
+                trainable_specs = {"lora": trainable_specs["lora"]}
+                lcfg = self.lora_cfg
+
+                def combine(tr, fz, _lcfg=lcfg):
+                    from eventgpt_tpu.train.lora import merge_lora
+
+                    return {"clip": fz["clip"], "projector": fz["projector"],
+                            "llama": merge_lora(fz["llama"], tr["lora"], _lcfg)}
+
+                self.combine = combine
+            else:
+                self.combine = steps_mod.make_stage2_combine(self.lora_cfg)
         else:
+            if train_args.freeze_mm_mlp_adapter:
+                raise ValueError(
+                    "freeze_mm_mlp_adapter with stage 1 would leave nothing "
+                    "trainable (stage 1 trains only the projector)"
+                )
             trainable, frozen = steps_mod.split_stage1(params)
             trainable_specs = {"projector": proj_specs}
             self.combine = steps_mod.stage1_combine
@@ -153,11 +182,18 @@ class Trainer:
             "step": self.state.step,
         })
         if is_primary():
-            ckpt.save_component(
-                os.path.join(self.targs.output_dir, f"projector_{tag}.npz"),
-                jax.device_get(self.state.trainable["projector"]),
-                prefix="model.visual_projector.",
-            )
+            if "projector" in self.state.trainable:
+                ckpt.save_component(
+                    os.path.join(self.targs.output_dir, f"projector_{tag}.npz"),
+                    jax.device_get(self.state.trainable["projector"]),
+                    prefix="model.visual_projector.",
+                )
+            if "lora" in self.state.trainable:
+                ckpt.save_component(
+                    os.path.join(self.targs.output_dir, f"lora_{tag}.npz"),
+                    jax.device_get(self.state.trainable["lora"]),
+                    prefix="lora.",
+                )
         return out
 
     def resume(self, path: str) -> None:
@@ -181,6 +217,12 @@ class Trainer:
         t_start = time.perf_counter()
         tokens_seen = 0
 
+        if len(self.dataset) < targs.per_device_train_batch_size:
+            raise ValueError(
+                f"dataset has {len(self.dataset)} entries but batch size is "
+                f"{targs.per_device_train_batch_size}; every epoch would yield "
+                f"zero batches (drop_last)"
+            )
         # With max_steps > 0, cycle epochs until the step budget is spent
         # (HF Trainer semantics); otherwise run num_train_epochs exactly.
         epochs = targs.num_train_epochs if targs.max_steps <= 0 else 10**9
@@ -197,12 +239,14 @@ class Trainer:
                 batch = steps_mod.batch_to_device(host_batch, self.mesh)
                 t0 = time.perf_counter()
                 self.state, metrics = self.train_step(self.state, batch)
-                loss = float(jax.device_get(metrics["loss"]))
-                dt = time.perf_counter() - t0
                 step += 1
                 tokens_seen += int(host_batch["attn_mask"].sum())
 
                 if step % targs.logging_steps == 0 or step == 1:
+                    # Host readback only on logging steps — an unconditional
+                    # device_get would fence async dispatch every step.
+                    loss = float(jax.device_get(metrics["loss"]))
+                    dt = time.perf_counter() - t0
                     last_metrics = {
                         "step": step, "epoch": epoch, "loss": loss,
                         "grad_norm": float(jax.device_get(metrics["grad_norm"])),

@@ -1,0 +1,164 @@
+// Pinhole camera models for the native preprocessing toolchain.
+//
+// TPU-era equivalents of CamBase<T>/CamRadtan<T>
+// (preprocess/feature_track/CamBase.h, CamRadtan.h): intrinsics management,
+// Brown–Conrady radial-tangential distortion with analytic forward model and
+// Newton-iteration undistortion (the reference delegates undistortion to
+// cv::undistortPoints, which itself iterates), projective transforms between
+// camera/pixel frames, depth lookup with bilinear interpolation and
+// neighborhood fallback, and SE3 extrinsics between rig cameras.
+#pragma once
+
+#include <algorithm>
+#include <cmath>
+#include <optional>
+#include <vector>
+
+#include "egpt/math.hpp"
+
+namespace egpt {
+
+struct Intrinsics {
+  double fx = 1, fy = 1, cx = 0, cy = 0;
+  int width = 0, height = 0;
+
+  Vec2 normalized_to_pixel(const Vec2& n) const {
+    return {fx * n.x + cx, fy * n.y + cy};
+  }
+  Vec2 pixel_to_normalized(const Vec2& p) const {
+    return {(p.x - cx) / fx, (p.y - cy) / fy};
+  }
+  bool in_bounds(const Vec2& p, double margin = 0.0) const {
+    return p.x >= margin && p.y >= margin && p.x < width - margin && p.y < height - margin;
+  }
+};
+
+// Brown–Conrady: k1 k2 p1 p2 k3 (OpenCV ordering, CamRadtan.h:88-139).
+struct RadtanDistortion {
+  double k1 = 0, k2 = 0, p1 = 0, p2 = 0, k3 = 0;
+
+  Vec2 distort(const Vec2& n) const {
+    const double x = n.x, y = n.y;
+    const double r2 = x * x + y * y;
+    const double radial = 1 + r2 * (k1 + r2 * (k2 + r2 * k3));
+    return {x * radial + 2 * p1 * x * y + p2 * (r2 + 2 * x * x),
+            y * radial + p1 * (r2 + 2 * y * y) + 2 * p2 * x * y};
+  }
+
+  // 2x2 Jacobian d(distorted)/d(normalized) (CamRadtan.h:147-190).
+  void jacobian(const Vec2& n, double J[4]) const {
+    const double x = n.x, y = n.y;
+    const double r2 = x * x + y * y;
+    const double radial = 1 + r2 * (k1 + r2 * (k2 + r2 * k3));
+    const double dradial_dr2 = k1 + 2 * k2 * r2 + 3 * k3 * r2 * r2;
+    J[0] = radial + x * (2 * x) * dradial_dr2 + 2 * p1 * y + 6 * p2 * x;
+    J[1] = x * (2 * y) * dradial_dr2 + 2 * p1 * x + 2 * p2 * y;
+    J[2] = y * (2 * x) * dradial_dr2 + 2 * p1 * x + 2 * p2 * y;
+    J[3] = radial + y * (2 * y) * dradial_dr2 + 6 * p1 * y + 2 * p2 * x;
+  }
+
+  // Newton undistortion; converges in <6 iterations for realistic lenses.
+  Vec2 undistort(const Vec2& d, int iters = 10) const {
+    Vec2 n = d;
+    for (int i = 0; i < iters; ++i) {
+      const Vec2 e = distort(n) - d;
+      double J[4];
+      jacobian(n, J);
+      const double det = J[0] * J[3] - J[1] * J[2];
+      if (std::abs(det) < 1e-14) break;
+      const double dx = (J[3] * e.x - J[1] * e.y) / det;
+      const double dy = (-J[2] * e.x + J[0] * e.y) / det;
+      n.x -= dx;
+      n.y -= dy;
+      if (std::abs(dx) + std::abs(dy) < 1e-12) break;
+    }
+    return n;
+  }
+};
+
+class RadtanCamera {
+ public:
+  Intrinsics K;
+  RadtanDistortion D;
+  // Extrinsics: transform taking points in this camera's frame to rig/base
+  // frame (CamBase.h:524-548 keeps Depth<->RGB<->Event<->IMU SE3 chains).
+  SE3 T_base_cam = SE3::identity();
+
+  // pixel (distorted) -> unit-depth camera ray (CamBase.h:585-646).
+  Vec3 pixel_to_camera(const Vec2& px, double depth = 1.0) const {
+    const Vec2 n = D.undistort(K.pixel_to_normalized(px));
+    return {n.x * depth, n.y * depth, depth};
+  }
+
+  // camera point -> distorted pixel (CamBase.h:567-578). Fails behind camera.
+  std::optional<Vec2> camera_to_pixel(const Vec3& p) const {
+    if (p.z <= 1e-9) return std::nullopt;
+    const Vec2 n{p.x / p.z, p.y / p.z};
+    return K.normalized_to_pixel(D.distort(n));
+  }
+
+  // Direct pixel->pixel homography-style warp at fixed depth plane
+  // (pixel2pixel KRK^-1, CamBase.h:656-660).
+  std::optional<Vec2> pixel_to_pixel(const Vec2& px, double depth,
+                                     const RadtanCamera& other) const {
+    const Vec3 pc = pixel_to_camera(px, depth);
+    const Vec3 pw = T_base_cam * pc;
+    const Vec3 po = other.T_base_cam.inverse() * pw;
+    return other.camera_to_pixel(po);
+  }
+};
+
+// Depth map with bilinear lookup + neighborhood fallback
+// (CamBase.h get_depth :331-373, get_min_depth_in_range :380-412).
+class DepthMap {
+ public:
+  DepthMap(std::vector<float> data, int width, int height)
+      : data_(std::move(data)), w_(width), h_(height) {}
+
+  int width() const { return w_; }
+  int height() const { return h_; }
+  float at(int x, int y) const { return data_[static_cast<size_t>(y) * w_ + x]; }
+
+  // Bilinear over valid (>0, finite) neighbors (FeatureTransform.cpp:16-41).
+  std::optional<double> bilinear(const Vec2& p) const {
+    const int x0 = static_cast<int>(std::floor(p.x));
+    const int y0 = static_cast<int>(std::floor(p.y));
+    if (x0 < 0 || y0 < 0 || x0 + 1 >= w_ || y0 + 1 >= h_) return std::nullopt;
+    const double fx = p.x - x0, fy = p.y - y0;
+    const float d00 = at(x0, y0), d10 = at(x0 + 1, y0);
+    const float d01 = at(x0, y0 + 1), d11 = at(x0 + 1, y0 + 1);
+    double wsum = 0, dsum = 0;
+    auto acc = [&](float d, double w) {
+      if (d > 0 && std::isfinite(d)) {
+        wsum += w;
+        dsum += w * d;
+      }
+    };
+    acc(d00, (1 - fx) * (1 - fy));
+    acc(d10, fx * (1 - fy));
+    acc(d01, (1 - fx) * fy);
+    acc(d11, fx * fy);
+    if (wsum < 1e-9) return std::nullopt;
+    return dsum / wsum;
+  }
+
+  // Minimum valid depth in a square window (get_min_depth_in_range).
+  std::optional<double> min_in_range(const Vec2& center, int radius) const {
+    const int cx = static_cast<int>(std::lround(center.x));
+    const int cy = static_cast<int>(std::lround(center.y));
+    double best = -1;
+    for (int y = std::max(0, cy - radius); y <= std::min(h_ - 1, cy + radius); ++y)
+      for (int x = std::max(0, cx - radius); x <= std::min(w_ - 1, cx + radius); ++x) {
+        const float d = at(x, y);
+        if (d > 0 && std::isfinite(d) && (best < 0 || d < best)) best = d;
+      }
+    if (best < 0) return std::nullopt;
+    return best;
+  }
+
+ private:
+  std::vector<float> data_;
+  int w_, h_;
+};
+
+}  // namespace egpt

@@ -1,0 +1,38 @@
+// Flat key-value YAML-subset config reader — the ParamHandler equivalent.
+//
+// The reference's C++ reads a flat "key: v1 v2 ..." YAML via an external
+// ParamHandler (EventsDataIO.cpp:46-51, mc_state_estimation_config.yaml).
+// This reader covers that format: one "key: values" pair per line, values
+// whitespace-separated scalars; '#' comments; later keys override earlier.
+#pragma once
+
+#include <map>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "egpt/camera.hpp"
+
+namespace egpt {
+
+class Config {
+ public:
+  static std::optional<Config> Load(const std::string& path);
+  static Config Parse(const std::string& text);
+
+  bool has(const std::string& key) const { return values_.count(key) > 0; }
+  std::optional<std::string> get_str(const std::string& key) const;
+  std::optional<double> get_double(const std::string& key) const;
+  std::optional<std::vector<double>> get_doubles(const std::string& key) const;
+
+  // Assemble a camera from "<prefix>_intrinsics: fx fy cx cy",
+  // "<prefix>_distortion: k1 k2 p1 p2 [k3]", "<prefix>_resolution: w h",
+  // "<prefix>_T_base_cam: qx qy qz qw tx ty tz" (quaternion xyzw + xyz, the
+  // rig-config convention of mc_state_estimation_config.yaml:1-27).
+  std::optional<RadtanCamera> get_camera(const std::string& prefix) const;
+
+ private:
+  std::map<std::string, std::string> values_;
+};
+
+}  // namespace egpt

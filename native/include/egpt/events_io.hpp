@@ -1,0 +1,93 @@
+// Threaded event-stream producer/consumer — the TPU-era EventsDataIO<T>.
+//
+// Replaces preprocess/feature_track/EventsDataIO.cpp structurally: a producer
+// thread reads events from a file (txt "t x y p" lines, or npy structured
+// {x,y,t,p} — the same schema dataset/io.py and samples/*.npy use), buffers
+// them into ~packet_us packets, and pushes to a mutex-guarded queue; the
+// consumer pops all packets up to a time horizon, splitting a straddling
+// packet and re-queuing the remainder (PopDataUntil semantics,
+// EventsDataIO.cpp:80-145). Live-camera SDK backends (Metavision) are
+// replaced by file replay with optional wall-clock pacing
+// (GoOfflineTxt's pacing loop, EventsDataIO.cpp:329-335).
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace egpt {
+
+struct Event {
+  double t = 0;  // seconds
+  uint16_t x = 0, y = 0;
+  uint8_t p = 0;
+};
+
+struct EventPacket {
+  std::vector<Event> events;
+  double t_begin = 0, t_end = 0;
+};
+
+class EventsDataIO {
+ public:
+  struct Options {
+    double packet_us = 1000.0;  // ~1 ms packets (EventsDataIO.cpp:386-402)
+    bool paced = false;         // replay at wall-clock rate
+    double pace_factor = 1.0;   // >1 = faster than real time
+  };
+
+  // Two ctors instead of a defaulted Options argument: GCC rejects nested-
+  // class NSDMI defaults used as default arguments inside the enclosing class.
+  EventsDataIO() = default;
+  explicit EventsDataIO(const Options& opts) : opts_(opts) {}
+  ~EventsDataIO() { Stop(); }
+
+  // Spawn the producer thread reading a whitespace "t x y p" file
+  // (GoOfflineTxt). t in seconds or microseconds (auto-detected: values
+  // > 1e7 are treated as microseconds).
+  bool GoOfflineTxt(const std::string& path);
+
+  // Spawn the producer thread reading a structured npy with fields
+  // x/y/t/p (the samples/sample1.npy schema; t in microseconds).
+  bool GoOfflineNpy(const std::string& path);
+
+  // Push a packet (producer side). Thread-safe.
+  void PushData(EventPacket&& packet);
+
+  // Pop every event with t <= horizon (seconds) into out; a packet
+  // straddling the horizon is split and its tail re-queued. Returns number
+  // of events popped. Non-blocking.
+  size_t PopDataUntil(double horizon, std::vector<Event>& out);
+
+  // True while the producer thread is alive or the queue is non-empty.
+  bool Running() const;
+
+  // Stop and join the producer (Stop, EventsDataIO.cpp:28-43).
+  void Stop();
+
+  size_t queue_size() const;
+
+ private:
+  void ProduceFromVector(std::vector<Event> events);
+
+  Options opts_;
+  mutable std::mutex mutex_;
+  std::condition_variable cv_;
+  std::deque<EventPacket> queue_;
+  std::thread producer_;
+  std::atomic<bool> producing_{false};
+  std::atomic<bool> stop_requested_{false};
+};
+
+// Standalone npy loaders (shared with the C-ABI bindings).
+// Returns false on parse failure. Handles structured dtypes with x/y/t/p
+// fields of unsigned/signed integer or float types, little-endian.
+bool LoadEventsNpy(const std::string& path, std::vector<Event>& out);
+bool LoadEventsTxt(const std::string& path, std::vector<Event>& out);
+
+}  // namespace egpt

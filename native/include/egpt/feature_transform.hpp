@@ -1,0 +1,35 @@
+// Cross-camera feature reprojection — the TPU-era TrackBase<T> transfer.
+//
+// Structural equivalent of preprocess/feature_track/FeatureTransform.cpp:
+// undistort pixel -> bilinear depth lookup -> back-project -> SE3 to the
+// other camera -> project -> re-distort, with per-point validity filtering
+// (out-of-bounds / invalid depth / behind camera).
+#pragma once
+
+#include <vector>
+
+#include "egpt/camera.hpp"
+
+namespace egpt {
+
+struct FeaturePoint {
+  int id = -1;
+  Vec2 px;        // pixel in source camera (distorted coords)
+  bool valid = false;
+};
+
+struct TransformResult {
+  std::vector<FeaturePoint> points;  // same order as input; valid flag set
+  int num_valid = 0;
+};
+
+// Project features from cam_src (with a depth map in its frame) into
+// cam_dst. ``depth_scale`` converts depth-map units to meters.
+TransformResult ProjectFeatures(const std::vector<FeaturePoint>& features,
+                                const RadtanCamera& cam_src,
+                                const RadtanCamera& cam_dst,
+                                const DepthMap& depth_src,
+                                double depth_scale = 1.0,
+                                double border_margin = 1.0);
+
+}  // namespace egpt

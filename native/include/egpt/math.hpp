@@ -1,0 +1,127 @@
+// Minimal linear-algebra types for the native preprocessing toolchain.
+//
+// The reference leans on Eigen + Sophus (preprocess/feature_track/CamBase.h:1-9);
+// neither ships in this image, and the toolchain needs only 2/3-vectors, 3x3
+// matrices and SE3 poses — so they are implemented here, self-contained.
+#pragma once
+
+#include <array>
+#include <cmath>
+#include <cstddef>
+
+namespace egpt {
+
+struct Vec2 {
+  double x = 0, y = 0;
+  Vec2 operator+(const Vec2& o) const { return {x + o.x, y + o.y}; }
+  Vec2 operator-(const Vec2& o) const { return {x - o.x, y - o.y}; }
+  Vec2 operator*(double s) const { return {x * s, y * s}; }
+  double norm() const { return std::sqrt(x * x + y * y); }
+};
+
+struct Vec3 {
+  double x = 0, y = 0, z = 0;
+  Vec3 operator+(const Vec3& o) const { return {x + o.x, y + o.y, z + o.z}; }
+  Vec3 operator-(const Vec3& o) const { return {x - o.x, y - o.y, z - o.z}; }
+  Vec3 operator*(double s) const { return {x * s, y * s, z * s}; }
+  double dot(const Vec3& o) const { return x * o.x + y * o.y + z * o.z; }
+  Vec3 cross(const Vec3& o) const {
+    return {y * o.z - z * o.y, z * o.x - x * o.z, x * o.y - y * o.x};
+  }
+  double norm() const { return std::sqrt(dot(*this)); }
+};
+
+struct Mat3 {
+  // Row-major.
+  std::array<double, 9> m{1, 0, 0, 0, 1, 0, 0, 0, 1};
+
+  static Mat3 identity() { return Mat3{}; }
+
+  double& operator()(int r, int c) { return m[r * 3 + c]; }
+  double operator()(int r, int c) const { return m[r * 3 + c]; }
+
+  Vec3 operator*(const Vec3& v) const {
+    return {m[0] * v.x + m[1] * v.y + m[2] * v.z,
+            m[3] * v.x + m[4] * v.y + m[5] * v.z,
+            m[6] * v.x + m[7] * v.y + m[8] * v.z};
+  }
+  Mat3 operator*(const Mat3& o) const {
+    Mat3 r;
+    for (int i = 0; i < 3; ++i)
+      for (int j = 0; j < 3; ++j) {
+        double s = 0;
+        for (int k = 0; k < 3; ++k) s += (*this)(i, k) * o(k, j);
+        r(i, j) = s;
+      }
+    return r;
+  }
+  Mat3 transpose() const {
+    Mat3 r;
+    for (int i = 0; i < 3; ++i)
+      for (int j = 0; j < 3; ++j) r(i, j) = (*this)(j, i);
+    return r;
+  }
+  double det() const {
+    return m[0] * (m[4] * m[8] - m[5] * m[7]) - m[1] * (m[3] * m[8] - m[5] * m[6]) +
+           m[2] * (m[3] * m[7] - m[4] * m[6]);
+  }
+  Mat3 inverse() const {
+    const double d = det();
+    Mat3 r;
+    r.m = {(m[4] * m[8] - m[5] * m[7]) / d, (m[2] * m[7] - m[1] * m[8]) / d,
+           (m[1] * m[5] - m[2] * m[4]) / d, (m[5] * m[6] - m[3] * m[8]) / d,
+           (m[0] * m[8] - m[2] * m[6]) / d, (m[2] * m[3] - m[0] * m[5]) / d,
+           (m[3] * m[7] - m[4] * m[6]) / d, (m[1] * m[6] - m[0] * m[7]) / d,
+           (m[0] * m[4] - m[1] * m[3]) / d};
+    return r;
+  }
+};
+
+// Unit quaternion (x, y, z, w) + translation — the Sophus::SE3 replacement.
+struct SE3 {
+  std::array<double, 4> q{0, 0, 0, 1};  // x y z w
+  Vec3 t;
+
+  static SE3 identity() { return SE3{}; }
+
+  static SE3 from_quat_trans(double qx, double qy, double qz, double qw, const Vec3& t) {
+    SE3 out;
+    const double n = std::sqrt(qx * qx + qy * qy + qz * qz + qw * qw);
+    out.q = {qx / n, qy / n, qz / n, qw / n};
+    out.t = t;
+    return out;
+  }
+
+  Mat3 rotation() const {
+    const double x = q[0], y = q[1], z = q[2], w = q[3];
+    Mat3 r;
+    r.m = {1 - 2 * (y * y + z * z), 2 * (x * y - w * z), 2 * (x * z + w * y),
+           2 * (x * y + w * z), 1 - 2 * (x * x + z * z), 2 * (y * z - w * x),
+           2 * (x * z - w * y), 2 * (y * z + w * x), 1 - 2 * (x * x + y * y)};
+    return r;
+  }
+
+  Vec3 operator*(const Vec3& p) const { return rotation() * p + t; }
+
+  SE3 inverse() const {
+    SE3 out;
+    out.q = {-q[0], -q[1], -q[2], q[3]};
+    out.t = (out.rotation() * t) * -1.0;
+    return out;
+  }
+
+  SE3 operator*(const SE3& o) const {
+    // Hamilton product, then compose translation.
+    const double x1 = q[0], y1 = q[1], z1 = q[2], w1 = q[3];
+    const double x2 = o.q[0], y2 = o.q[1], z2 = o.q[2], w2 = o.q[3];
+    SE3 out;
+    out.q = {w1 * x2 + x1 * w2 + y1 * z2 - z1 * y2,
+             w1 * y2 - x1 * z2 + y1 * w2 + z1 * x2,
+             w1 * z2 + x1 * y2 - y1 * x2 + z1 * w2,
+             w1 * w2 - x1 * x2 - y1 * y2 - z1 * z2};
+    out.t = rotation() * o.t + t;
+    return out;
+  }
+};
+
+}  // namespace egpt

@@ -1,0 +1,72 @@
+// Pyramidal Lucas–Kanade tracking with forward–backward consistency and
+// RANSAC outlier rejection — the TPU-era TrackKLT<T>.
+//
+// Structural equivalent of preprocess/feature_track/OpticalFlow.cpp:2-70,
+// reimplemented without OpenCV: image pyramids by 2x box downsampling,
+// iterative LK per level with a square window, forward-backward check
+// (<=0.5 px, OpticalFlow.cpp:28-41), and RANSAC on a fundamental matrix
+// estimated by the normalized 8-point algorithm in normalized image
+// coordinates with a focal-scaled inlier threshold (OpticalFlow.cpp:44-69).
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <vector>
+
+#include "egpt/camera.hpp"
+
+namespace egpt {
+
+// Grayscale float image, row-major.
+struct GrayImage {
+  std::vector<float> data;
+  int width = 0, height = 0;
+
+  float at(int x, int y) const { return data[static_cast<size_t>(y) * width + x]; }
+  // Bilinear sample with border clamp.
+  float sample(double x, double y) const;
+  GrayImage downsample2() const;
+};
+
+struct KLTOptions {
+  int pyramid_levels = 3;
+  int window_radius = 7;       // 15x15 window
+  int max_iters = 30;
+  double epsilon = 0.01;       // convergence threshold (px)
+  double fb_threshold = 0.5;   // forward-backward check (OpticalFlow.cpp:37)
+  double min_eigen = 1e-4;     // conditioning floor for the 2x2 system
+};
+
+struct TrackedPoint {
+  Vec2 prev, cur;
+  bool valid = false;
+};
+
+// Track points from prev to cur. Returns one TrackedPoint per input.
+std::vector<TrackedPoint> TrackKLT(const GrayImage& prev, const GrayImage& cur,
+                                   const std::vector<Vec2>& points,
+                                   const KLTOptions& opts = {});
+
+struct RansacOptions {
+  int iterations = 200;
+  double threshold_px = 1.0;   // scaled by focal length into normalized coords
+  uint64_t seed = 42;
+};
+
+// Fundamental-matrix RANSAC over matched normalized coordinates; marks
+// inliers. ``focal`` scales threshold_px into normalized units
+// (OpticalFlow.cpp:44-69 divides by max focal length).
+std::vector<bool> RansacFundamental(const std::vector<Vec2>& pts0_norm,
+                                    const std::vector<Vec2>& pts1_norm,
+                                    double focal,
+                                    const RansacOptions& opts = {});
+
+// Full matching step: KLT + FB check + undistort-to-normalized + RANSAC,
+// mirroring perform_matching (OpticalFlow.cpp:2-70).
+std::vector<TrackedPoint> PerformMatching(const GrayImage& prev, const GrayImage& cur,
+                                          const std::vector<Vec2>& points,
+                                          const RadtanCamera& cam,
+                                          const KLTOptions& klt = {},
+                                          const RansacOptions& ransac = {});
+
+}  // namespace egpt

@@ -1,0 +1,28 @@
+// Event rasterization + equal-count splitting — the native host hot path.
+//
+// Same semantics as eventgpt_tpu/ops/raster.py (itself the redesign of the
+// reference's per-event Python loop, common/common.py:64-74): white
+// background, last event at a pixel wins, polarity 1 -> red, 0 -> blue.
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+#include "egpt/events_io.hpp"
+
+namespace egpt {
+
+// out must hold height*width*3 bytes (RGB, row-major).
+void RasterizeEvents(const uint16_t* x, const uint16_t* y, const uint8_t* p,
+                     size_t n, int height, int width, uint8_t* out);
+
+// Convenience over Event records; auto-sizes to (max_y+1, max_x+1) when
+// height/width are 0. Returns frame dims via out params.
+std::vector<uint8_t> RasterizeEvents(const std::vector<Event>& events,
+                                     int& height, int& width);
+
+// Equal-event-count split points: n slices, slice i = [i*total/n, (i+1)*total/n)
+// with the last slice absorbing the remainder (common/common.py:17-37).
+std::vector<std::pair<size_t, size_t>> SplitByCount(size_t total, int n);
+
+}  // namespace egpt

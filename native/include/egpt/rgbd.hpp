@@ -1,0 +1,35 @@
+// RGB-D replay + depth reprojection — the TPU-era RgbdDataIO<T>.
+//
+// Structural equivalent of preprocess/feature_track/RgbdDataIO.cpp with the
+// camera SDKs (librealsense) and simulator (MuJoCo) replaced by file-backed
+// replay: frames are read from disk, and the per-pixel KRK^-1 warp of the
+// depth image into another camera's frame reproduces
+// ProjectDepthToRgbAndEvent (RgbdDataIO.cpp:172-277) including the
+// keep-minimum-depth z-buffer and pixel-footprint splatting.
+#pragma once
+
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "egpt/camera.hpp"
+
+namespace egpt {
+
+// Reproject a depth map from cam_src into cam_dst's pixel grid.
+// Returns a dst-sized depth map; unobserved pixels are 0. Each source pixel
+// footprint is splatted into the destination with a keep-min z-buffer
+// (RgbdDataIO.cpp:172-277).
+DepthMap ProjectDepth(const DepthMap& depth_src, const RadtanCamera& cam_src,
+                      const RadtanCamera& cam_dst, double depth_scale = 1.0,
+                      int splat_radius = 1);
+
+// Minimal PGM (P5, 16-bit or 8-bit) depth reader and PPM (P6) RGB reader —
+// the file-backed replacements for the RealSense frame queue.
+std::optional<DepthMap> ReadDepthPgm(const std::string& path, double scale_to_m = 0.001);
+bool ReadRgbPpm(const std::string& path, std::vector<uint8_t>& rgb, int& w, int& h);
+
+// RGB -> grayscale float (for the KLT tracker).
+std::vector<float> RgbToGray(const std::vector<uint8_t>& rgb, int w, int h);
+
+}  // namespace egpt

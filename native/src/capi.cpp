@@ -1,0 +1,77 @@
+// C ABI for the Python side (ctypes — pybind11 is not in this image).
+//
+// Exposes the host hot ops so the JAX data pipeline can call into native
+// code: rasterization (the measured hot spot, common/common.py:64-74),
+// npy event loading, and the full load->split->rasterize pipeline.
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "egpt/events_io.hpp"
+#include "egpt/raster.hpp"
+
+extern "C" {
+
+// Rasterize n events into out (h*w*3 uint8, preallocated by caller).
+void egpt_rasterize(const uint16_t* x, const uint16_t* y, const uint8_t* p,
+                    size_t n, int height, int width, uint8_t* out) {
+  egpt::RasterizeEvents(x, y, p, n, height, width, out);
+}
+
+// Load a structured npy; returns event count or -1. Caller then calls
+// egpt_events_fetch to copy fields out and egpt_events_free to release.
+struct EgptEvents {
+  std::vector<egpt::Event> events;
+};
+
+void* egpt_events_load(const char* path) {
+  auto* holder = new EgptEvents();
+  if (!egpt::LoadEventsNpy(path, holder->events)) {
+    delete holder;
+    return nullptr;
+  }
+  return holder;
+}
+
+int64_t egpt_events_count(void* handle) {
+  return handle ? static_cast<int64_t>(static_cast<EgptEvents*>(handle)->events.size()) : -1;
+}
+
+void egpt_events_fetch(void* handle, uint16_t* x, uint16_t* y, double* t, uint8_t* p) {
+  auto* holder = static_cast<EgptEvents*>(handle);
+  for (size_t i = 0; i < holder->events.size(); ++i) {
+    x[i] = holder->events[i].x;
+    y[i] = holder->events[i].y;
+    t[i] = holder->events[i].t;
+    p[i] = holder->events[i].p;
+  }
+}
+
+void egpt_events_free(void* handle) { delete static_cast<EgptEvents*>(handle); }
+
+// Full host pipeline: load npy -> n_frames equal-count slices -> rasterize.
+// out must hold n_frames*height*width*3 bytes; height/width must be the
+// stream's (max_y+1, max_x+1) or larger. Returns 0 on success.
+int egpt_npy_to_frames(const char* path, int n_frames, int height, int width,
+                       uint8_t* out) {
+  std::vector<egpt::Event> events;
+  if (!egpt::LoadEventsNpy(path, events)) return -1;
+  if (events.size() < static_cast<size_t>(n_frames)) return -2;
+  const auto slices = egpt::SplitByCount(events.size(), n_frames);
+  const size_t frame_bytes = static_cast<size_t>(height) * width * 3;
+  for (int i = 0; i < n_frames; ++i) {
+    const auto [lo, hi] = slices[i];
+    std::vector<uint16_t> xs(hi - lo), ys(hi - lo);
+    std::vector<uint8_t> ps(hi - lo);
+    for (size_t j = lo; j < hi; ++j) {
+      xs[j - lo] = events[j].x;
+      ys[j - lo] = events[j].y;
+      ps[j - lo] = events[j].p;
+    }
+    egpt::RasterizeEvents(xs.data(), ys.data(), ps.data(), hi - lo, height,
+                          width, out + static_cast<size_t>(i) * frame_bytes);
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,95 @@
+#include "egpt/config.hpp"
+
+#include <fstream>
+#include <sstream>
+
+namespace egpt {
+
+std::optional<Config> Config::Load(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) return std::nullopt;
+  std::stringstream ss;
+  ss << f.rdbuf();
+  return Parse(ss.str());
+}
+
+Config Config::Parse(const std::string& text) {
+  Config cfg;
+  std::istringstream ss(text);
+  std::string line;
+  while (std::getline(ss, line)) {
+    const size_t hash = line.find('#');
+    if (hash != std::string::npos) line = line.substr(0, hash);
+    const size_t colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    auto trim = [](std::string s) {
+      const auto b = s.find_first_not_of(" \t\r\"[],");
+      const auto e = s.find_last_not_of(" \t\r\"[],");
+      return b == std::string::npos ? std::string() : s.substr(b, e - b + 1);
+    };
+    const std::string key = trim(line.substr(0, colon));
+    std::string val = line.substr(colon + 1);
+    // Strip list punctuation so "[1, 2, 3]" and "1 2 3" both parse.
+    for (auto& c : val)
+      if (c == '[' || c == ']' || c == ',') c = ' ';
+    val = trim(val);
+    if (!key.empty()) cfg.values_[key] = val;
+  }
+  return cfg;
+}
+
+std::optional<std::string> Config::get_str(const std::string& key) const {
+  const auto it = values_.find(key);
+  if (it == values_.end()) return std::nullopt;
+  return it->second;
+}
+
+std::optional<double> Config::get_double(const std::string& key) const {
+  const auto v = get_str(key);
+  if (!v) return std::nullopt;
+  try {
+    return std::stod(*v);
+  } catch (...) {
+    return std::nullopt;
+  }
+}
+
+std::optional<std::vector<double>> Config::get_doubles(const std::string& key) const {
+  const auto v = get_str(key);
+  if (!v) return std::nullopt;
+  std::vector<double> out;
+  std::istringstream ss(*v);
+  double d;
+  while (ss >> d) out.push_back(d);
+  return out;
+}
+
+std::optional<RadtanCamera> Config::get_camera(const std::string& prefix) const {
+  const auto intr = get_doubles(prefix + "_intrinsics");
+  const auto res = get_doubles(prefix + "_resolution");
+  if (!intr || intr->size() < 4 || !res || res->size() < 2) return std::nullopt;
+  RadtanCamera cam;
+  cam.K.fx = (*intr)[0];
+  cam.K.fy = (*intr)[1];
+  cam.K.cx = (*intr)[2];
+  cam.K.cy = (*intr)[3];
+  cam.K.width = static_cast<int>((*res)[0]);
+  cam.K.height = static_cast<int>((*res)[1]);
+  if (const auto dist = get_doubles(prefix + "_distortion");
+      dist && dist->size() >= 4) {
+    cam.D.k1 = (*dist)[0];
+    cam.D.k2 = (*dist)[1];
+    cam.D.p1 = (*dist)[2];
+    cam.D.p2 = (*dist)[3];
+    if (dist->size() >= 5) cam.D.k3 = (*dist)[4];
+  }
+  if (const auto ext = get_doubles(prefix + "_T_base_cam");
+      ext && ext->size() >= 7) {
+    cam.T_base_cam = SE3::from_quat_trans(
+        (*ext)[0], (*ext)[1], (*ext)[2], (*ext)[3],
+        {(*ext)[4], (*ext)[5], (*ext)[6]});
+  }
+  return cam;
+}
+
+}  // namespace egpt

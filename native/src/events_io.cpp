@@ -1,0 +1,282 @@
+#include "egpt/events_io.hpp"
+
+#include <algorithm>
+#include <chrono>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <sstream>
+
+namespace egpt {
+
+// ---------------------------------------------------------------------------
+// npy structured-array reader (schema of samples/sample1.npy: fields x,y,t,p)
+
+namespace {
+
+struct FieldDesc {
+  char kind = 0;     // 'u', 'i', 'f'
+  int size = 0;      // bytes
+  size_t offset = 0;
+};
+
+double ReadField(const char* rec, const FieldDesc& f) {
+  const char* p = rec + f.offset;
+  switch (f.kind) {
+    case 'u':
+      switch (f.size) {
+        case 1: { uint8_t v; std::memcpy(&v, p, 1); return v; }
+        case 2: { uint16_t v; std::memcpy(&v, p, 2); return v; }
+        case 4: { uint32_t v; std::memcpy(&v, p, 4); return v; }
+        case 8: { uint64_t v; std::memcpy(&v, p, 8); return static_cast<double>(v); }
+      }
+      break;
+    case 'i':
+      switch (f.size) {
+        case 1: { int8_t v; std::memcpy(&v, p, 1); return v; }
+        case 2: { int16_t v; std::memcpy(&v, p, 2); return v; }
+        case 4: { int32_t v; std::memcpy(&v, p, 4); return v; }
+        case 8: { int64_t v; std::memcpy(&v, p, 8); return static_cast<double>(v); }
+      }
+      break;
+    case 'f':
+      switch (f.size) {
+        case 4: { float v; std::memcpy(&v, p, 4); return v; }
+        case 8: { double v; std::memcpy(&v, p, 8); return v; }
+      }
+      break;
+  }
+  return 0;
+}
+
+// Parse "('x', '<u2')" style tuples out of the header's descr list.
+bool ParseDescr(const std::string& header, std::map<std::string, FieldDesc>& fields,
+                size_t& itemsize) {
+  const size_t dpos = header.find("'descr'");
+  if (dpos == std::string::npos) return false;
+  const size_t lb = header.find('[', dpos);
+  const size_t rb = header.find(']', lb);
+  if (lb == std::string::npos || rb == std::string::npos) return false;
+  std::string body = header.substr(lb + 1, rb - lb - 1);
+
+  size_t offset = 0;
+  size_t pos = 0;
+  while ((pos = body.find('(', pos)) != std::string::npos) {
+    const size_t end = body.find(')', pos);
+    if (end == std::string::npos) break;
+    std::string tup = body.substr(pos + 1, end - pos - 1);
+    // tokens: 'name', '<u2'
+    std::vector<std::string> toks;
+    size_t q = 0;
+    while ((q = tup.find('\'', q)) != std::string::npos) {
+      const size_t q2 = tup.find('\'', q + 1);
+      if (q2 == std::string::npos) break;
+      toks.push_back(tup.substr(q + 1, q2 - q - 1));
+      q = q2 + 1;
+    }
+    if (toks.size() >= 2) {
+      const std::string& name = toks[0];
+      const std::string& dt = toks[1];
+      FieldDesc f;
+      size_t i = 0;
+      if (dt[i] == '<' || dt[i] == '=' || dt[i] == '|' || dt[i] == '>') {
+        if (dt[i] == '>') return false;  // big-endian unsupported
+        ++i;
+      }
+      f.kind = dt[i];
+      f.size = std::atoi(dt.c_str() + i + 1);
+      f.offset = offset;
+      offset += f.size;
+      fields[name] = f;
+    }
+    pos = end + 1;
+  }
+  itemsize = offset;
+  return !fields.empty();
+}
+
+}  // namespace
+
+bool LoadEventsNpy(const std::string& path, std::vector<Event>& out) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return false;
+  char magic[6];
+  f.read(magic, 6);
+  if (!f || std::memcmp(magic, "\x93NUMPY", 6) != 0) return false;
+  uint8_t ver[2];
+  f.read(reinterpret_cast<char*>(ver), 2);
+  uint32_t header_len = 0;
+  if (ver[0] == 1) {
+    uint16_t hl;
+    f.read(reinterpret_cast<char*>(&hl), 2);
+    header_len = hl;
+  } else {
+    f.read(reinterpret_cast<char*>(&header_len), 4);
+  }
+  std::string header(header_len, '\0');
+  f.read(header.data(), header_len);
+  if (!f) return false;
+
+  std::map<std::string, FieldDesc> fields;
+  size_t itemsize = 0;
+  if (!ParseDescr(header, fields, itemsize)) return false;
+  if (!fields.count("x") || !fields.count("y") || !fields.count("t") || !fields.count("p"))
+    return false;
+
+  // shape: "(N,)"
+  const size_t sp = header.find("'shape'");
+  const size_t lp = header.find('(', sp);
+  size_t n = std::strtoull(header.c_str() + lp + 1, nullptr, 10);
+
+  std::vector<char> buf(itemsize * n);
+  f.read(buf.data(), static_cast<std::streamsize>(buf.size()));
+  if (!f) return false;
+
+  const FieldDesc fx = fields["x"], fy = fields["y"], ft = fields["t"], fp = fields["p"];
+  out.clear();
+  out.reserve(n);
+  for (size_t i = 0; i < n; ++i) {
+    const char* rec = buf.data() + i * itemsize;
+    Event e;
+    e.x = static_cast<uint16_t>(ReadField(rec, fx));
+    e.y = static_cast<uint16_t>(ReadField(rec, fy));
+    e.t = ReadField(rec, ft) * 1e-6;  // microseconds -> seconds
+    e.p = static_cast<uint8_t>(ReadField(rec, fp));
+    out.push_back(e);
+  }
+  return true;
+}
+
+bool LoadEventsTxt(const std::string& path, std::vector<Event>& out) {
+  std::ifstream f(path);
+  if (!f) return false;
+  out.clear();
+  std::string line;
+  while (std::getline(f, line)) {
+    if (line.empty() || line[0] == '#') continue;
+    std::istringstream ss(line);
+    double t, x, y, p;
+    if (!(ss >> t >> x >> y >> p)) continue;
+    Event e;
+    e.t = t;
+    e.x = static_cast<uint16_t>(x);
+    e.y = static_cast<uint16_t>(y);
+    e.p = static_cast<uint8_t>(p);
+    out.push_back(e);
+  }
+  // Unit detection on the full stream: timestamps beyond 1e5 "seconds"
+  // (28 h) mean the file is in microseconds (the DSEC/npy convention).
+  if (!out.empty() && out.back().t > 1e5) {
+    for (auto& e : out) e.t *= 1e-6;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Threaded producer / consumer
+
+void EventsDataIO::ProduceFromVector(std::vector<Event> events) {
+  producing_ = true;
+  const double packet_s = opts_.packet_us * 1e-6;
+  const auto wall_start = std::chrono::steady_clock::now();
+  const double t0 = events.empty() ? 0.0 : events.front().t;
+
+  EventPacket packet;
+  for (auto& e : events) {
+    if (stop_requested_) break;
+    if (packet.events.empty()) packet.t_begin = e.t;
+    packet.events.push_back(e);
+    packet.t_end = e.t;
+    if (packet.t_end - packet.t_begin >= packet_s) {
+      if (opts_.paced) {
+        // Wall-clock pacing (EventsDataIO.cpp:329-335): sleep until the
+        // packet's end time has elapsed in (scaled) real time.
+        const double stream_elapsed = (packet.t_end - t0) / opts_.pace_factor;
+        const auto target = wall_start + std::chrono::duration_cast<
+            std::chrono::steady_clock::duration>(
+                std::chrono::duration<double>(stream_elapsed));
+        std::this_thread::sleep_until(target);
+      }
+      PushData(std::move(packet));
+      packet = EventPacket{};
+    }
+  }
+  if (!packet.events.empty() && !stop_requested_) PushData(std::move(packet));
+  producing_ = false;
+  cv_.notify_all();
+}
+
+bool EventsDataIO::GoOfflineTxt(const std::string& path) {
+  std::vector<Event> events;
+  if (!LoadEventsTxt(path, events)) return false;
+  Stop();
+  stop_requested_ = false;
+  producing_ = true;
+  producer_ = std::thread(&EventsDataIO::ProduceFromVector, this, std::move(events));
+  return true;
+}
+
+bool EventsDataIO::GoOfflineNpy(const std::string& path) {
+  std::vector<Event> events;
+  if (!LoadEventsNpy(path, events)) return false;
+  Stop();
+  stop_requested_ = false;
+  producing_ = true;
+  producer_ = std::thread(&EventsDataIO::ProduceFromVector, this, std::move(events));
+  return true;
+}
+
+void EventsDataIO::PushData(EventPacket&& packet) {
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    queue_.push_back(std::move(packet));
+  }
+  cv_.notify_all();
+}
+
+size_t EventsDataIO::PopDataUntil(double horizon, std::vector<Event>& out) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  size_t popped = 0;
+  while (!queue_.empty()) {
+    EventPacket& front = queue_.front();
+    if (front.t_begin > horizon) break;
+    if (front.t_end <= horizon) {
+      popped += front.events.size();
+      out.insert(out.end(), front.events.begin(), front.events.end());
+      queue_.pop_front();
+      continue;
+    }
+    // Straddling packet: split at horizon, re-queue the tail
+    // (EventsDataIO.cpp:80-145).
+    auto it = std::partition_point(
+        front.events.begin(), front.events.end(),
+        [&](const Event& e) { return e.t <= horizon; });
+    out.insert(out.end(), front.events.begin(), it);
+    popped += static_cast<size_t>(it - front.events.begin());
+    front.events.erase(front.events.begin(), it);
+    front.t_begin = front.events.empty() ? horizon : front.events.front().t;
+    break;
+  }
+  return popped;
+}
+
+bool EventsDataIO::Running() const {
+  if (producing_) return true;
+  std::lock_guard<std::mutex> lock(mutex_);
+  return !queue_.empty();
+}
+
+void EventsDataIO::Stop() {
+  stop_requested_ = true;
+  cv_.notify_all();
+  if (producer_.joinable()) producer_.join();
+  std::lock_guard<std::mutex> lock(mutex_);
+  queue_.clear();
+}
+
+size_t EventsDataIO::queue_size() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return queue_.size();
+}
+
+}  // namespace egpt

@@ -1,0 +1,47 @@
+#include "egpt/feature_transform.hpp"
+
+namespace egpt {
+
+TransformResult ProjectFeatures(const std::vector<FeaturePoint>& features,
+                                const RadtanCamera& cam_src,
+                                const RadtanCamera& cam_dst,
+                                const DepthMap& depth_src,
+                                double depth_scale,
+                                double border_margin) {
+  TransformResult result;
+  result.points.reserve(features.size());
+  const SE3 T_dst_src = cam_dst.T_base_cam.inverse() * cam_src.T_base_cam;
+
+  for (const auto& f : features) {
+    FeaturePoint out;
+    out.id = f.id;
+    // 1. Depth at the (distorted) source pixel, bilinear with valid-neighbor
+    //    weighting (FeatureTransform.cpp:16-41); fallback to window minimum.
+    auto d = depth_src.bilinear(f.px);
+    if (!d) d = depth_src.min_in_range(f.px, 2);
+    if (!d || *d <= 0) {
+      result.points.push_back(out);
+      continue;
+    }
+    const double depth_m = *d * depth_scale;
+
+    // 2. Undistort + back-project to a 3D point in the source camera frame.
+    const Vec3 p_src = cam_src.pixel_to_camera(f.px, depth_m);
+
+    // 3. SE3 into the destination camera frame (CamBase.h:558-560).
+    const Vec3 p_dst = T_dst_src * p_src;
+
+    // 4. Project + re-distort; reject behind-camera and out-of-bounds
+    //    (FeatureTransform.cpp validity filtering).
+    const auto px_dst = cam_dst.camera_to_pixel(p_dst);
+    if (px_dst && cam_dst.K.in_bounds(*px_dst, border_margin)) {
+      out.px = *px_dst;
+      out.valid = true;
+      ++result.num_valid;
+    }
+    result.points.push_back(out);
+  }
+  return result;
+}
+
+}  // namespace egpt

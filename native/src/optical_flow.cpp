@@ -1,0 +1,283 @@
+#include "egpt/optical_flow.hpp"
+
+#include <algorithm>
+#include <array>
+#include <cmath>
+#include <random>
+
+namespace egpt {
+
+float GrayImage::sample(double x, double y) const {
+  x = std::clamp(x, 0.0, static_cast<double>(width - 1));
+  y = std::clamp(y, 0.0, static_cast<double>(height - 1));
+  const int x0 = static_cast<int>(x), y0 = static_cast<int>(y);
+  const int x1 = std::min(x0 + 1, width - 1), y1 = std::min(y0 + 1, height - 1);
+  const double fx = x - x0, fy = y - y0;
+  return static_cast<float>(
+      at(x0, y0) * (1 - fx) * (1 - fy) + at(x1, y0) * fx * (1 - fy) +
+      at(x0, y1) * (1 - fx) * fy + at(x1, y1) * fx * fy);
+}
+
+GrayImage GrayImage::downsample2() const {
+  GrayImage out;
+  out.width = width / 2;
+  out.height = height / 2;
+  out.data.resize(static_cast<size_t>(out.width) * out.height);
+  for (int y = 0; y < out.height; ++y)
+    for (int x = 0; x < out.width; ++x) {
+      out.data[static_cast<size_t>(y) * out.width + x] =
+          0.25f * (at(2 * x, 2 * y) + at(2 * x + 1, 2 * y) +
+                   at(2 * x, 2 * y + 1) + at(2 * x + 1, 2 * y + 1));
+    }
+  return out;
+}
+
+namespace {
+
+// Single-level iterative LK around an initial guess; returns refined point
+// or nullopt if the normal matrix is degenerate / point leaves the image.
+std::optional<Vec2> LKLevel(const GrayImage& prev, const GrayImage& cur,
+                            const Vec2& p_prev, Vec2 guess, const KLTOptions& o) {
+  const int r = o.window_radius;
+  // Spatial gradient (Scharr-free central differences) and template values.
+  const int n = (2 * r + 1) * (2 * r + 1);
+  std::vector<float> tmpl(n), gx(n), gy(n);
+  int idx = 0;
+  double a11 = 0, a12 = 0, a22 = 0;
+  for (int dy = -r; dy <= r; ++dy)
+    for (int dx = -r; dx <= r; ++dx, ++idx) {
+      const double x = p_prev.x + dx, y = p_prev.y + dy;
+      tmpl[idx] = prev.sample(x, y);
+      const float ix = static_cast<float>(
+          0.5 * (prev.sample(x + 1, y) - prev.sample(x - 1, y)));
+      const float iy = static_cast<float>(
+          0.5 * (prev.sample(x, y + 1) - prev.sample(x, y - 1)));
+      gx[idx] = ix;
+      gy[idx] = iy;
+      a11 += ix * ix;
+      a12 += ix * iy;
+      a22 += iy * iy;
+    }
+  const double det = a11 * a22 - a12 * a12;
+  const double tr = a11 + a22;
+  const double min_eig = 0.5 * (tr - std::sqrt(std::max(tr * tr - 4 * det, 0.0)));
+  if (min_eig / n < o.min_eigen || det <= 0) return std::nullopt;
+
+  for (int it = 0; it < o.max_iters; ++it) {
+    double b1 = 0, b2 = 0;
+    idx = 0;
+    for (int dy = -r; dy <= r; ++dy)
+      for (int dx = -r; dx <= r; ++dx, ++idx) {
+        const float diff =
+            cur.sample(guess.x + dx, guess.y + dy) - tmpl[idx];
+        b1 += diff * gx[idx];
+        b2 += diff * gy[idx];
+      }
+    const double vx = -(a22 * b1 - a12 * b2) / det;
+    const double vy = -(-a12 * b1 + a11 * b2) / det;
+    guess.x += vx;
+    guess.y += vy;
+    if (std::sqrt(vx * vx + vy * vy) < o.epsilon) break;
+  }
+  if (guess.x < 0 || guess.y < 0 || guess.x >= cur.width || guess.y >= cur.height)
+    return std::nullopt;
+  return guess;
+}
+
+std::optional<Vec2> LKPyramidal(const std::vector<GrayImage>& pyr_prev,
+                                const std::vector<GrayImage>& pyr_cur,
+                                const Vec2& p, const KLTOptions& o) {
+  const int levels = static_cast<int>(pyr_prev.size());
+  const double top_scale = std::pow(0.5, levels - 1);
+  Vec2 guess{p.x * top_scale, p.y * top_scale};
+  for (int lv = levels - 1; lv >= 0; --lv) {
+    const double s = std::pow(0.5, lv);
+    const Vec2 p_lv{p.x * s, p.y * s};
+    auto refined = LKLevel(pyr_prev[lv], pyr_cur[lv], p_lv, guess, o);
+    if (!refined) return std::nullopt;
+    guess = *refined;
+    if (lv > 0) guess = guess * 2.0;
+  }
+  return guess;
+}
+
+std::vector<GrayImage> BuildPyramid(const GrayImage& img, int levels) {
+  std::vector<GrayImage> pyr{img};
+  for (int i = 1; i < levels; ++i) {
+    if (pyr.back().width < 16 || pyr.back().height < 16) break;
+    pyr.push_back(pyr.back().downsample2());
+  }
+  return pyr;
+}
+
+// Symmetric Jacobi eigen-decomposition for the 9x9 normal matrix of the
+// 8-point algorithm (smallest-eigenvector extraction, no external LA).
+void JacobiEigen9(std::array<double, 81>& A, std::array<double, 81>& V) {
+  for (int i = 0; i < 81; ++i) V[i] = 0;
+  for (int i = 0; i < 9; ++i) V[i * 9 + i] = 1;
+  for (int sweep = 0; sweep < 50; ++sweep) {
+    double off = 0;
+    for (int p = 0; p < 9; ++p)
+      for (int q = p + 1; q < 9; ++q) off += A[p * 9 + q] * A[p * 9 + q];
+    if (off < 1e-18) break;
+    for (int p = 0; p < 9; ++p)
+      for (int q = p + 1; q < 9; ++q) {
+        const double apq = A[p * 9 + q];
+        if (std::abs(apq) < 1e-18) continue;
+        const double app = A[p * 9 + p], aqq = A[q * 9 + q];
+        const double theta = 0.5 * (aqq - app) / apq;
+        const double t = (theta >= 0 ? 1.0 : -1.0) /
+                         (std::abs(theta) + std::sqrt(theta * theta + 1));
+        const double c = 1.0 / std::sqrt(t * t + 1), s = t * c;
+        for (int k = 0; k < 9; ++k) {
+          const double akp = A[k * 9 + p], akq = A[k * 9 + q];
+          A[k * 9 + p] = c * akp - s * akq;
+          A[k * 9 + q] = s * akp + c * akq;
+        }
+        for (int k = 0; k < 9; ++k) {
+          const double apk = A[p * 9 + k], aqk = A[q * 9 + k];
+          A[p * 9 + k] = c * apk - s * aqk;
+          A[q * 9 + k] = s * apk + c * aqk;
+        }
+        for (int k = 0; k < 9; ++k) {
+          const double vkp = V[k * 9 + p], vkq = V[k * 9 + q];
+          V[k * 9 + p] = c * vkp - s * vkq;
+          V[k * 9 + q] = s * vkp + c * vkq;
+        }
+      }
+  }
+}
+
+// 8-point fundamental matrix from >=8 normalized correspondences.
+std::optional<Mat3> EightPoint(const std::vector<Vec2>& p0,
+                               const std::vector<Vec2>& p1,
+                               const std::vector<int>& idxs) {
+  std::array<double, 81> AtA{};
+  for (int i : idxs) {
+    const double u = p0[i].x, v = p0[i].y, up = p1[i].x, vp = p1[i].y;
+    const double row[9] = {up * u, up * v, up, vp * u, vp * v, vp, u, v, 1};
+    for (int a = 0; a < 9; ++a)
+      for (int b = 0; b < 9; ++b) AtA[a * 9 + b] += row[a] * row[b];
+  }
+  std::array<double, 81> V{};
+  JacobiEigen9(AtA, V);
+  // Smallest eigenvalue's eigenvector.
+  int best = 0;
+  double best_val = AtA[0];
+  for (int i = 1; i < 9; ++i)
+    if (AtA[i * 9 + i] < best_val) {
+      best_val = AtA[i * 9 + i];
+      best = i;
+    }
+  Mat3 F;
+  for (int i = 0; i < 9; ++i) F.m[i] = V[i * 9 + best];
+  return F;
+}
+
+double SampsonError(const Mat3& F, const Vec2& p0, const Vec2& p1) {
+  const Vec3 x0{p0.x, p0.y, 1}, x1{p1.x, p1.y, 1};
+  const Vec3 Fx0 = F * x0;
+  const Vec3 Ftx1 = F.transpose() * x1;
+  const double num = x1.dot(Fx0);
+  const double den = Fx0.x * Fx0.x + Fx0.y * Fx0.y + Ftx1.x * Ftx1.x + Ftx1.y * Ftx1.y;
+  if (den < 1e-18) return 1e18;
+  return num * num / den;
+}
+
+}  // namespace
+
+std::vector<TrackedPoint> TrackKLT(const GrayImage& prev, const GrayImage& cur,
+                                   const std::vector<Vec2>& points,
+                                   const KLTOptions& opts) {
+  const auto pyr_prev = BuildPyramid(prev, opts.pyramid_levels);
+  const auto pyr_cur = BuildPyramid(cur, opts.pyramid_levels);
+  std::vector<TrackedPoint> out(points.size());
+  for (size_t i = 0; i < points.size(); ++i) {
+    out[i].prev = points[i];
+    auto fwd = LKPyramidal(pyr_prev, pyr_cur, points[i], opts);
+    if (!fwd) continue;
+    // Forward-backward consistency (OpticalFlow.cpp:28-41).
+    auto bwd = LKPyramidal(pyr_cur, pyr_prev, *fwd, opts);
+    if (!bwd || (*bwd - points[i]).norm() > opts.fb_threshold) continue;
+    out[i].cur = *fwd;
+    out[i].valid = true;
+  }
+  return out;
+}
+
+std::vector<bool> RansacFundamental(const std::vector<Vec2>& p0,
+                                    const std::vector<Vec2>& p1,
+                                    double focal,
+                                    const RansacOptions& opts) {
+  const size_t n = p0.size();
+  std::vector<bool> inliers(n, false);
+  if (n < 8) return inliers;
+  const double thresh = opts.threshold_px / focal;  // OpticalFlow.cpp:62
+  const double thresh2 = thresh * thresh;
+
+  std::mt19937_64 rng(opts.seed);
+  std::uniform_int_distribution<size_t> dist(0, n - 1);
+  int best_count = 0;
+  std::vector<bool> best(n, false);
+
+  for (int it = 0; it < opts.iterations; ++it) {
+    std::vector<int> sample;
+    while (sample.size() < 8) {
+      const int c = static_cast<int>(dist(rng));
+      if (std::find(sample.begin(), sample.end(), c) == sample.end())
+        sample.push_back(c);
+    }
+    auto F = EightPoint(p0, p1, sample);
+    if (!F) continue;
+    int count = 0;
+    std::vector<bool> cur(n, false);
+    for (size_t i = 0; i < n; ++i) {
+      if (SampsonError(*F, p0[i], p1[i]) < thresh2) {
+        cur[i] = true;
+        ++count;
+      }
+    }
+    if (count > best_count) {
+      best_count = count;
+      best = cur;
+    }
+  }
+  // Final refit on all inliers for stability.
+  if (best_count >= 8) {
+    std::vector<int> all;
+    for (size_t i = 0; i < n; ++i)
+      if (best[i]) all.push_back(static_cast<int>(i));
+    if (auto F = EightPoint(p0, p1, all)) {
+      for (size_t i = 0; i < n; ++i)
+        best[i] = SampsonError(*F, p0[i], p1[i]) < thresh2;
+    }
+  }
+  return best;
+}
+
+std::vector<TrackedPoint> PerformMatching(const GrayImage& prev, const GrayImage& cur,
+                                          const std::vector<Vec2>& points,
+                                          const RadtanCamera& cam,
+                                          const KLTOptions& klt,
+                                          const RansacOptions& ransac) {
+  auto tracked = TrackKLT(prev, cur, points, klt);
+
+  // Collect valid matches in normalized coordinates (OpticalFlow.cpp:44-58).
+  std::vector<Vec2> n0, n1;
+  std::vector<size_t> map;
+  for (size_t i = 0; i < tracked.size(); ++i) {
+    if (!tracked[i].valid) continue;
+    const Vec3 c0 = cam.pixel_to_camera(tracked[i].prev);
+    const Vec3 c1 = cam.pixel_to_camera(tracked[i].cur);
+    n0.push_back({c0.x, c0.y});
+    n1.push_back({c1.x, c1.y});
+    map.push_back(i);
+  }
+  const double focal = std::max(cam.K.fx, cam.K.fy);
+  const auto inl = RansacFundamental(n0, n1, focal, ransac);
+  for (size_t j = 0; j < map.size(); ++j)
+    if (!inl[j]) tracked[map[j]].valid = false;
+  return tracked;
+}
+
+}  // namespace egpt

@@ -1,0 +1,55 @@
+#include "egpt/raster.hpp"
+
+#include <algorithm>
+#include <cstring>
+
+namespace egpt {
+
+void RasterizeEvents(const uint16_t* x, const uint16_t* y, const uint8_t* p,
+                     size_t n, int height, int width, uint8_t* out) {
+  std::memset(out, 255, static_cast<size_t>(height) * width * 3);
+  // Sequential overwrite IS last-write-wins; one linear pass, cache-friendly.
+  for (size_t i = 0; i < n; ++i) {
+    if (x[i] >= width || y[i] >= height) continue;
+    uint8_t* px = out + (static_cast<size_t>(y[i]) * width + x[i]) * 3;
+    if (p[i] != 0) {        // red
+      px[0] = 255; px[1] = 0; px[2] = 0;
+    } else {                // blue
+      px[0] = 0; px[1] = 0; px[2] = 255;
+    }
+  }
+}
+
+std::vector<uint8_t> RasterizeEvents(const std::vector<Event>& events,
+                                     int& height, int& width) {
+  if (height <= 0 || width <= 0) {
+    int max_x = 0, max_y = 0;
+    for (const auto& e : events) {
+      max_x = std::max<int>(max_x, e.x);
+      max_y = std::max<int>(max_y, e.y);
+    }
+    width = max_x + 1;
+    height = max_y + 1;
+  }
+  std::vector<uint8_t> out(static_cast<size_t>(height) * width * 3, 255);
+  for (const auto& e : events) {
+    if (e.x >= width || e.y >= height) continue;
+    uint8_t* px = out.data() + (static_cast<size_t>(e.y) * width + e.x) * 3;
+    if (e.p != 0) { px[0] = 255; px[1] = 0; px[2] = 0; }
+    else          { px[0] = 0;   px[1] = 0; px[2] = 255; }
+  }
+  return out;
+}
+
+std::vector<std::pair<size_t, size_t>> SplitByCount(size_t total, int n) {
+  std::vector<std::pair<size_t, size_t>> out;
+  const size_t per = total / static_cast<size_t>(n);
+  for (int i = 0; i < n; ++i) {
+    const size_t lo = static_cast<size_t>(i) * per;
+    const size_t hi = (i == n - 1) ? total : lo + per;
+    out.emplace_back(lo, hi);
+  }
+  return out;
+}
+
+}  // namespace egpt

@@ -1,0 +1,117 @@
+#include "egpt/rgbd.hpp"
+
+#include <algorithm>
+#include <cmath>
+#include <fstream>
+
+namespace egpt {
+
+DepthMap ProjectDepth(const DepthMap& depth_src, const RadtanCamera& cam_src,
+                      const RadtanCamera& cam_dst, double depth_scale,
+                      int splat_radius) {
+  std::vector<float> out(static_cast<size_t>(cam_dst.K.width) * cam_dst.K.height, 0.f);
+  const SE3 T_dst_src = cam_dst.T_base_cam.inverse() * cam_src.T_base_cam;
+
+  for (int y = 0; y < depth_src.height(); ++y) {
+    for (int x = 0; x < depth_src.width(); ++x) {
+      const float d = depth_src.at(x, y);
+      if (d <= 0 || !std::isfinite(d)) continue;
+      const double dm = d * depth_scale;
+      const Vec3 p_src = cam_src.pixel_to_camera({static_cast<double>(x),
+                                                  static_cast<double>(y)}, dm);
+      const Vec3 p_dst = T_dst_src * p_src;
+      const auto px = cam_dst.camera_to_pixel(p_dst);
+      if (!px) continue;
+      const int cx = static_cast<int>(std::lround(px->x));
+      const int cy = static_cast<int>(std::lround(px->y));
+      // Splat the pixel footprint with keep-min z-buffer
+      // (RgbdDataIO.cpp:172-277 warps the footprint corners; a fixed splat
+      // radius covers the same occlusion-filling purpose).
+      for (int sy = cy - splat_radius; sy <= cy + splat_radius; ++sy) {
+        if (sy < 0 || sy >= cam_dst.K.height) continue;
+        for (int sx = cx - splat_radius; sx <= cx + splat_radius; ++sx) {
+          if (sx < 0 || sx >= cam_dst.K.width) continue;
+          float& slot = out[static_cast<size_t>(sy) * cam_dst.K.width + sx];
+          const float dz = static_cast<float>(p_dst.z);
+          if (slot <= 0 || dz < slot) slot = dz;
+        }
+      }
+    }
+  }
+  return DepthMap(std::move(out), cam_dst.K.width, cam_dst.K.height);
+}
+
+namespace {
+
+bool SkipWs(std::ifstream& f) {
+  int c;
+  while ((c = f.peek()) != EOF) {
+    if (c == '#') {
+      std::string line;
+      std::getline(f, line);
+    } else if (std::isspace(c)) {
+      f.get();
+    } else {
+      break;
+    }
+  }
+  return f.good();
+}
+
+}  // namespace
+
+std::optional<DepthMap> ReadDepthPgm(const std::string& path, double scale_to_m) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return std::nullopt;
+  std::string magic;
+  f >> magic;
+  if (magic != "P5") return std::nullopt;
+  int w, h, maxval;
+  SkipWs(f); f >> w;
+  SkipWs(f); f >> h;
+  SkipWs(f); f >> maxval;
+  f.get();  // single whitespace after header
+  std::vector<float> depth(static_cast<size_t>(w) * h);
+  if (maxval > 255) {
+    std::vector<uint8_t> raw(static_cast<size_t>(w) * h * 2);
+    f.read(reinterpret_cast<char*>(raw.data()), static_cast<std::streamsize>(raw.size()));
+    if (!f) return std::nullopt;
+    for (size_t i = 0; i < depth.size(); ++i) {
+      const uint16_t v = static_cast<uint16_t>((raw[2 * i] << 8) | raw[2 * i + 1]);
+      depth[i] = static_cast<float>(v * scale_to_m);
+    }
+  } else {
+    std::vector<uint8_t> raw(static_cast<size_t>(w) * h);
+    f.read(reinterpret_cast<char*>(raw.data()), static_cast<std::streamsize>(raw.size()));
+    if (!f) return std::nullopt;
+    for (size_t i = 0; i < depth.size(); ++i)
+      depth[i] = static_cast<float>(raw[i] * scale_to_m);
+  }
+  return DepthMap(std::move(depth), w, h);
+}
+
+bool ReadRgbPpm(const std::string& path, std::vector<uint8_t>& rgb, int& w, int& h) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return false;
+  std::string magic;
+  f >> magic;
+  if (magic != "P6") return false;
+  int maxval;
+  SkipWs(f); f >> w;
+  SkipWs(f); f >> h;
+  SkipWs(f); f >> maxval;
+  f.get();
+  rgb.resize(static_cast<size_t>(w) * h * 3);
+  f.read(reinterpret_cast<char*>(rgb.data()), static_cast<std::streamsize>(rgb.size()));
+  return static_cast<bool>(f);
+}
+
+std::vector<float> RgbToGray(const std::vector<uint8_t>& rgb, int w, int h) {
+  std::vector<float> gray(static_cast<size_t>(w) * h);
+  for (size_t i = 0; i < gray.size(); ++i) {
+    gray[i] = 0.299f * rgb[3 * i] + 0.587f * rgb[3 * i + 1] + 0.114f * rgb[3 * i + 2];
+  }
+  return gray;
+}
+
+}  // namespace egpt

@@ -1,0 +1,344 @@
+// Assert-style unit tests for the native toolchain (no framework dep).
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <fstream>
+#include <iostream>
+#include <random>
+#include <chrono>
+#include <thread>
+
+#include "egpt/camera.hpp"
+#include "egpt/config.hpp"
+#include "egpt/events_io.hpp"
+#include "egpt/feature_transform.hpp"
+#include "egpt/optical_flow.hpp"
+#include "egpt/raster.hpp"
+#include "egpt/rgbd.hpp"
+
+using namespace egpt;
+
+static int failures = 0;
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::cerr << "FAIL " << __FILE__ << ":" << __LINE__ << "  " #cond   \
+                << "\n";                                                  \
+      ++failures;                                                         \
+    }                                                                     \
+  } while (0)
+
+#define CHECK_NEAR(a, b, tol) CHECK(std::abs((a) - (b)) < (tol))
+
+static void TestMath() {
+  const SE3 T = SE3::from_quat_trans(0.1, 0.2, 0.3, 0.9, {1, 2, 3});
+  const Vec3 p{0.5, -0.2, 2.0};
+  const Vec3 q = T.inverse() * (T * p);
+  CHECK_NEAR(q.x, p.x, 1e-12);
+  CHECK_NEAR(q.y, p.y, 1e-12);
+  CHECK_NEAR(q.z, p.z, 1e-12);
+
+  const Mat3 R = T.rotation();
+  const Mat3 I = R * R.transpose();
+  CHECK_NEAR(I(0, 0), 1, 1e-12);
+  CHECK_NEAR(I(0, 1), 0, 1e-12);
+  CHECK_NEAR(R.det(), 1, 1e-12);
+
+  const Mat3 Rinv = R.inverse();
+  const Mat3 I2 = R * Rinv;
+  CHECK_NEAR(I2(1, 1), 1, 1e-12);
+
+  // Composition consistency.
+  const SE3 A = SE3::from_quat_trans(0, 0, 0.3826834, 0.9238795, {1, 0, 0});
+  const SE3 B = SE3::from_quat_trans(0.2, -0.1, 0, 0.97, {0, 1, 0});
+  const Vec3 via_compose = (A * B) * p;
+  const Vec3 via_seq = A * (B * p);
+  CHECK_NEAR(via_compose.x, via_seq.x, 1e-9);
+  CHECK_NEAR(via_compose.z, via_seq.z, 1e-9);
+}
+
+static void TestCamera() {
+  RadtanCamera cam;
+  cam.K = {400, 400, 320, 240, 640, 480};
+  cam.D = {-0.3, 0.1, 1e-4, -2e-4, 0.01};
+
+  // distort/undistort roundtrip over the frame.
+  for (double u = 40; u < 600; u += 100) {
+    for (double v = 40; v < 440; v += 80) {
+      const Vec2 n = cam.K.pixel_to_normalized({u, v});
+      const Vec2 d = cam.D.distort(n);
+      const Vec2 n2 = cam.D.undistort(d);
+      CHECK_NEAR(n2.x, n.x, 1e-9);
+      CHECK_NEAR(n2.y, n.y, 1e-9);
+    }
+  }
+
+  // pixel -> camera -> pixel roundtrip.
+  const Vec2 px{123.0, 321.0};
+  const Vec3 pc = cam.pixel_to_camera(px, 2.5);
+  const auto px2 = cam.camera_to_pixel(pc);
+  CHECK(px2.has_value());
+  CHECK_NEAR(px2->x, px.x, 1e-6);
+  CHECK_NEAR(px2->y, px.y, 1e-6);
+
+  // Behind camera rejected.
+  CHECK(!cam.camera_to_pixel({0, 0, -1}).has_value());
+
+  // Jacobian vs finite differences.
+  const Vec2 n{0.2, -0.3};
+  double J[4];
+  cam.D.jacobian(n, J);
+  const double eps = 1e-7;
+  const Vec2 dx = (cam.D.distort({n.x + eps, n.y}) - cam.D.distort({n.x - eps, n.y})) * (0.5 / eps);
+  const Vec2 dy = (cam.D.distort({n.x, n.y + eps}) - cam.D.distort({n.x, n.y - eps})) * (0.5 / eps);
+  CHECK_NEAR(J[0], dx.x, 1e-5);
+  CHECK_NEAR(J[2], dx.y, 1e-5);
+  CHECK_NEAR(J[1], dy.x, 1e-5);
+  CHECK_NEAR(J[3], dy.y, 1e-5);
+}
+
+static void TestDepthMap() {
+  std::vector<float> d(16, 0.f);
+  d[5] = 2.0f;  // (1,1)
+  d[6] = 4.0f;  // (2,1)
+  d[9] = 2.0f;  // (1,2)
+  d[10] = 4.0f; // (2,2)
+  DepthMap dm(d, 4, 4);
+  auto b = dm.bilinear({1.5, 1.5});
+  CHECK(b && std::abs(*b - 3.0) < 1e-9);
+  // Invalid-neighbor weighting: (0.5, 1.0) mixes valid (1,1) with invalid
+  // (0,1) -> falls back to the valid one only.
+  auto b2 = dm.bilinear({0.5, 1.0});
+  CHECK(b2 && std::abs(*b2 - 2.0) < 1e-9);
+  auto m = dm.min_in_range({2, 2}, 1);
+  CHECK(m && *m == 2.0);
+  CHECK(!dm.bilinear({-1, -1}).has_value());
+}
+
+static void TestEventsQueue() {
+  EventsDataIO io;
+  EventPacket p1;
+  for (int i = 0; i < 10; ++i) p1.events.push_back({i * 0.001, uint16_t(i), 0, 1});
+  p1.t_begin = 0;
+  p1.t_end = 0.009;
+  io.PushData(std::move(p1));
+
+  std::vector<Event> out;
+  // Horizon splits the packet: events at t <= 0.0045 are 0..4.
+  const size_t n = io.PopDataUntil(0.0045, out);
+  CHECK(n == 5);
+  CHECK(io.queue_size() == 1);
+  out.clear();
+  io.PopDataUntil(1.0, out);
+  CHECK(out.size() == 5);
+  CHECK(out.front().x == 5);
+  CHECK(io.queue_size() == 0);
+}
+
+static void TestEventsThreaded() {
+  // Producer thread via a temp txt file.
+  const char* path = "/tmp/egpt_test_events.txt";
+  {
+    std::ofstream f(path);
+    for (int i = 0; i < 5000; ++i)
+      f << 1000000 + i * 10 << " " << (i % 640) << " " << (i % 480) << " "
+        << (i % 2) << "\n";
+  }
+  EventsDataIO io({/*packet_us=*/1000.0, /*paced=*/false});
+  CHECK(io.GoOfflineTxt(path));
+  std::vector<Event> out;
+  // Drain until the producer finishes or a 10 s deadline passes.
+  const auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(10);
+  while (out.size() < 5000 && std::chrono::steady_clock::now() < deadline) {
+    io.PopDataUntil(1e9, out);
+    if (out.size() < 5000)
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  CHECK(out.size() == 5000);
+  CHECK_NEAR(out[1].t - out[0].t, 10e-6, 1e-9);  // µs auto-detect
+  io.Stop();
+  std::remove(path);
+}
+
+static void TestRaster() {
+  std::vector<Event> ev = {
+      {0.0, 1, 0, 1},  // red at (1,0)
+      {0.0, 0, 1, 0},  // blue at (0,1)
+      {0.0, 1, 0, 0},  // overwrites (1,0) -> blue (last wins)
+  };
+  int h = 2, w = 2;
+  auto frame = RasterizeEvents(ev, h, w);
+  CHECK(h == 2 && w == 2);
+  // (0,0) untouched white.
+  CHECK(frame[0] == 255 && frame[1] == 255 && frame[2] == 255);
+  // (1,0) blue.
+  CHECK(frame[3] == 0 && frame[5] == 255);
+  // (0,1) blue.
+  CHECK(frame[w * 3 + 0] == 0 && frame[w * 3 + 2] == 255);
+
+  auto splits = SplitByCount(10, 3);
+  CHECK(splits.size() == 3);
+  CHECK(splits[0].first == 0 && splits[0].second == 3);
+  CHECK(splits[2].first == 6 && splits[2].second == 10);
+}
+
+static void TestNpyLoader() {
+  // Generate a structured {x,y,t,p} npy by hand (the toolchain's on-disk
+  // schema; note the reference's sample1.npy is a *pickled dict* readable
+  // only from Python — the ctypes path passes arrays directly instead).
+  const char* path = "/tmp/egpt_test_events.npy";
+  {
+    std::string header =
+        "{'descr': [('x', '<u2'), ('y', '<u2'), ('t', '<u4'), ('p', '<u1')], "
+        "'fortran_order': False, 'shape': (3,), }";
+    while ((10 + header.size() + 1) % 64 != 0) header += ' ';
+    header += '\n';
+    std::ofstream f(path, std::ios::binary);
+    f.write("\x93NUMPY\x01\x00", 8);
+    const uint16_t hlen = static_cast<uint16_t>(header.size());
+    f.write(reinterpret_cast<const char*>(&hlen), 2);
+    f.write(header.data(), static_cast<std::streamsize>(header.size()));
+    struct __attribute__((packed)) Rec { uint16_t x, y; uint32_t t; uint8_t p; };
+    const Rec recs[3] = {{10, 20, 100, 1}, {11, 21, 200, 0}, {12, 22, 350, 1}};
+    f.write(reinterpret_cast<const char*>(recs), sizeof(recs));
+  }
+  std::vector<Event> ev;
+  CHECK(LoadEventsNpy(path, ev));
+  CHECK(ev.size() == 3);
+  if (ev.size() == 3) {
+    CHECK(ev[0].x == 10 && ev[0].y == 20 && ev[0].p == 1);
+    CHECK_NEAR(ev[2].t, 350e-6, 1e-12);
+  }
+  std::remove(path);
+}
+
+static void TestConfig() {
+  const std::string yaml =
+      "# rig config\n"
+      "data_path: /tmp/data\n"
+      "rgb_intrinsics: [390.0, 390.5, 320.1, 241.9]\n"
+      "rgb_distortion: [-0.05, 0.06, 0.0001, -0.0002]\n"
+      "rgb_resolution: [640, 480]\n"
+      "rgb_T_base_cam: 0 0 0 1 0.01 0.02 0.03\n"
+      "event_intrinsics: [550, 551, 170, 130]\n"
+      "event_resolution: [346, 260]\n";
+  Config cfg = Config::Parse(yaml);
+  CHECK(cfg.get_str("data_path").value() == "/tmp/data");
+  auto cam = cfg.get_camera("rgb");
+  CHECK(cam.has_value());
+  CHECK_NEAR(cam->K.fy, 390.5, 1e-12);
+  CHECK_NEAR(cam->D.k2, 0.06, 1e-12);
+  CHECK_NEAR(cam->T_base_cam.t.z, 0.03, 1e-12);
+  auto ev = cfg.get_camera("event");
+  CHECK(ev && ev->K.width == 346 && ev->D.k1 == 0.0);
+  CHECK(!cfg.get_camera("depth").has_value());
+}
+
+static GrayImage SyntheticImage(int w, int h, double shift_x, double shift_y) {
+  GrayImage img;
+  img.width = w;
+  img.height = h;
+  img.data.resize(static_cast<size_t>(w) * h);
+  // Smooth random blobs -> trackable texture.
+  for (int y = 0; y < h; ++y)
+    for (int x = 0; x < w; ++x) {
+      const double xs = x - shift_x, ys = y - shift_y;
+      double v = 120 + 60 * std::sin(xs * 0.12) * std::cos(ys * 0.09) +
+                 40 * std::sin(xs * 0.031 + ys * 0.045);
+      img.data[static_cast<size_t>(y) * w + x] = static_cast<float>(v);
+    }
+  return img;
+}
+
+static void TestKLT() {
+  const double dx = 3.7, dy = -2.2;
+  const auto prev = SyntheticImage(160, 120, 0, 0);
+  const auto cur = SyntheticImage(160, 120, dx, dy);
+  std::vector<Vec2> pts;
+  for (double y = 30; y < 100; y += 15)
+    for (double x = 30; x < 140; x += 15) pts.push_back({x, y});
+  const auto tracked = TrackKLT(prev, cur, pts);
+  int valid = 0;
+  double err = 0;
+  for (const auto& t : tracked) {
+    if (!t.valid) continue;
+    ++valid;
+    err += std::abs(t.cur.x - t.prev.x - dx) + std::abs(t.cur.y - t.prev.y - dy);
+  }
+  CHECK(valid > static_cast<int>(pts.size()) * 3 / 4);
+  CHECK(err / std::max(valid, 1) < 0.1);
+}
+
+static void TestRansac() {
+  // Matches consistent with a pure-translation epipolar geometry + outliers.
+  std::mt19937_64 rng(7);
+  std::uniform_real_distribution<double> U(-0.5, 0.5);
+  std::vector<Vec2> p0, p1;
+  const Vec3 t{0.2, 0.05, 1.0};  // camera translation
+  for (int i = 0; i < 60; ++i) {
+    const Vec3 X{U(rng) * 4, U(rng) * 4, 4 + 2 * U(rng)};
+    p0.push_back({X.x / X.z, X.y / X.z});
+    const Vec3 X2 = X - t;
+    p1.push_back({X2.x / X2.z, X2.y / X2.z});
+  }
+  for (int i = 0; i < 15; ++i) {  // gross outliers
+    p0.push_back({U(rng), U(rng)});
+    p1.push_back({U(rng), U(rng)});
+  }
+  const auto inl = RansacFundamental(p0, p1, 400.0, {400, 1.0, 123});
+  int in_true = 0, in_false = 0;
+  for (int i = 0; i < 60; ++i) in_true += inl[i];
+  for (int i = 60; i < 75; ++i) in_false += inl[i];
+  CHECK(in_true > 50);
+  CHECK(in_false < 5);
+}
+
+static void TestProjectDepthAndFeatures() {
+  RadtanCamera cam_rgb;
+  cam_rgb.K = {380, 380, 160, 120, 320, 240};
+  RadtanCamera cam_ev;
+  cam_ev.K = {300, 300, 160, 120, 320, 240};
+  // Event cam 5 cm to the right of RGB.
+  cam_ev.T_base_cam = SE3::from_quat_trans(0, 0, 0, 1, {0.05, 0, 0});
+
+  // Flat wall at 2 m in the RGB frame.
+  std::vector<float> d(320 * 240, 2.0f);
+  DepthMap depth(d, 320, 240);
+
+  const auto reproj = ProjectDepth(depth, cam_rgb, cam_ev);
+  // Center of the event view should see the wall at ~2 m.
+  CHECK_NEAR(reproj.at(160, 120), 2.0f, 1e-3);
+
+  std::vector<FeaturePoint> feats;
+  for (double x = 60; x < 280; x += 40) feats.push_back({0, {x, 120.0}, false});
+  for (size_t i = 0; i < feats.size(); ++i) feats[i].id = static_cast<int>(i);
+  const auto res = ProjectFeatures(feats, cam_rgb, cam_ev, depth);
+  CHECK(res.num_valid >= static_cast<int>(feats.size()) - 1);
+  // Analytic check: point at RGB center, wall z=2, baseline 0.05 m ->
+  // event pixel x = cx + fx * (-0.05) / 2 = 160 - 7.5.
+  FeaturePoint center{99, {160, 120}, false};
+  const auto r2 = ProjectFeatures({center}, cam_rgb, cam_ev, depth);
+  CHECK(r2.points[0].valid);
+  CHECK_NEAR(r2.points[0].px.x, 160 - 300 * 0.05 / 2.0, 1e-6);
+  CHECK_NEAR(r2.points[0].px.y, 120.0, 1e-6);
+}
+
+int main() {
+  TestMath();
+  TestCamera();
+  TestDepthMap();
+  TestEventsQueue();
+  TestEventsThreaded();
+  TestRaster();
+  TestNpyLoader();
+  TestConfig();
+  TestKLT();
+  TestRansac();
+  TestProjectDepthAndFeatures();
+  if (failures) {
+    std::cerr << failures << " check(s) failed\n";
+    return 1;
+  }
+  std::cout << "all native tests passed\n";
+  return 0;
+}

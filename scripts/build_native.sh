@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Build the native preprocessing toolchain + ctypes library.
+# Usage: scripts/build_native.sh [address|thread]  (optional sanitizer mode)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SAN="${1:-}"
+BUILD=native/build
+ARGS=(-DCMAKE_BUILD_TYPE=Release)
+if [[ -n "$SAN" ]]; then
+  BUILD="native/build-${SAN}"
+  ARGS+=(-DEGPT_SANITIZE="$SAN")
+fi
+
+cmake -S native -B "$BUILD" "${ARGS[@]}"
+cmake --build "$BUILD" -j"$(nproc)"
+ctest --test-dir "$BUILD" --output-on-failure

@@ -1,0 +1,122 @@
+"""Native (C++) toolchain bridge tests: parity with the numpy host path.
+
+Skipped when libegpt_native.so has not been built
+(scripts/build_native.sh). CI-style runs build it once; the framework
+falls back to the numpy scatter path automatically when absent.
+"""
+
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from eventgpt_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="libegpt_native.so not built"
+)
+
+
+def _numpy_raster(x, y, p, h, w):
+    lin = y.astype(np.int64) * w + x.astype(np.int64)
+    last = np.full(h * w, -1, dtype=np.int64)
+    np.maximum.at(last, lin, np.arange(lin.size, dtype=np.int64))
+    frame = np.full((h * w, 3), 255, dtype=np.uint8)
+    hit = last >= 0
+    pol = np.asarray(p)[last[hit]]
+    frame[hit] = np.where(
+        pol[:, None] != 0, np.array([255, 0, 0], np.uint8), np.array([0, 0, 255], np.uint8)
+    )
+    return frame.reshape(h, w, 3)
+
+
+def test_native_matches_numpy_random():
+    rng = np.random.default_rng(0)
+    n, h, w = 50_000, 240, 320
+    x = rng.integers(0, w, n).astype(np.uint16)
+    y = rng.integers(0, h, n).astype(np.uint16)
+    p = rng.integers(0, 2, n).astype(np.uint8)
+    np.testing.assert_array_equal(
+        native.rasterize_events_native(x, y, p, h, w), _numpy_raster(x, y, p, h, w)
+    )
+
+
+def test_native_matches_on_sample1(sample1_events):
+    ev = sample1_events
+    h = int(ev["y"].max()) + 1
+    w = int(ev["x"].max()) + 1
+    got = native.rasterize_events_native(ev["x"], ev["y"], ev["p"], h, w)
+    want = _numpy_raster(ev["x"], ev["y"], ev["p"], h, w)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_native_is_used_by_ops_raster(sample1_events):
+    from eventgpt_tpu.ops.raster import rasterize_events
+
+    ev = sample1_events
+    frame = rasterize_events(ev["x"], ev["y"], ev["p"])
+    assert frame.shape == (int(ev["y"].max()) + 1, int(ev["x"].max()) + 1, 3)
+
+
+def test_feature_track_binary_runs(tmp_path):
+    """End-to-end smoke of the offline generator on synthetic PPM/PGM data."""
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    binary = os.path.join(root, "native", "build", "egpt_feature_track")
+    if not os.path.exists(binary):
+        pytest.skip("egpt_feature_track not built")
+
+    w, h = 160, 120
+    rng = np.random.default_rng(1)
+    base = (
+        120 + 60 * np.sin(np.arange(w)[None, :] * 0.12) * np.cos(np.arange(h)[:, None] * 0.09)
+        + rng.normal(0, 2, (h, w))
+    ).clip(0, 255).astype(np.uint8)
+
+    for i, shift in enumerate([0, 3]):
+        img = np.roll(base, shift, axis=1)
+        rgb = np.repeat(img[:, :, None], 3, axis=2)
+        with open(tmp_path / f"frame_{i:06d}.ppm", "wb") as f:
+            f.write(f"P6\n{w} {h}\n255\n".encode())
+            f.write(rgb.tobytes())
+        depth = np.full((h, w), 2000, np.uint16)  # 2 m in mm, big-endian PGM
+        with open(tmp_path / f"depth_{i:06d}.pgm", "wb") as f:
+            f.write(f"P5\n{w} {h}\n65535\n".encode())
+            f.write(depth.byteswap().tobytes())
+
+    cfg = tmp_path / "rig.yaml"
+    cfg.write_text(
+        f"data_path: {tmp_path}\n"
+        "num_frames: 2\n"
+        "frame_dt: 0.033\n"
+        "rgb_intrinsics: [200, 200, 80, 60]\n"
+        "rgb_resolution: [160, 120]\n"
+        "event_intrinsics: [200, 200, 80, 60]\n"
+        "event_resolution: [160, 120]\n"
+        "event_T_base_cam: 0 0 0 1 0.02 0 0\n"
+    )
+    out_csv = tmp_path / "tracks.csv"
+    res = subprocess.run([binary, str(cfg), str(out_csv)], capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    lines = out_csv.read_text().strip().splitlines()
+    assert lines[0].startswith("frame,id")
+    assert len(lines) > 5  # tracked + projected a reasonable number of features
+
+
+def test_native_raster_speedup(sample1_events):
+    """The native pass should beat the numpy scatter comfortably."""
+    ev = sample1_events
+    h, w = int(ev["y"].max()) + 1, int(ev["x"].max()) + 1
+
+    t0 = time.perf_counter()
+    for _ in range(5):
+        native.rasterize_events_native(ev["x"], ev["y"], ev["p"], h, w)
+    t_native = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(5):
+        _numpy_raster(ev["x"], ev["y"], ev["p"], h, w)
+    t_numpy = time.perf_counter() - t0
+    # Not a hard perf gate — just catch pathological regressions.
+    assert t_native < t_numpy * 1.5, (t_native, t_numpy)

@@ -1,0 +1,85 @@
+"""Trainer flag semantics: freeze_mm_mlp_adapter, lora_weight_path, guards."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.data.tokenizer import load_tokenizer
+from eventgpt_tpu.models import eventchat
+from eventgpt_tpu.train.args import DataArguments, ModelArguments, TrainingArguments
+from eventgpt_tpu.train.trainer import Trainer
+
+SAMPLE_DIR = "/root/reference/samples"
+
+
+@pytest.fixture(scope="module")
+def toy_data(tmp_path_factory):
+    if not os.path.exists(os.path.join(SAMPLE_DIR, "sample1.npy")):
+        pytest.skip("reference sample not available")
+    d = tmp_path_factory.mktemp("data")
+    entries = [
+        {"id": i, "event": "sample1.npy",
+         "conversations": [
+             {"from": "human", "value": "<event>\nDescribe."},
+             {"from": "gpt", "value": f"A {i}."}]}
+        for i in range(4)
+    ]
+    p = d / "qa.json"
+    p.write_text(json.dumps(entries))
+    return str(p)
+
+
+def _trainer(toy_data, tmp_path, **targ_kw):
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    targ_kw.setdefault("per_device_train_batch_size", 2)
+    targs = TrainingArguments(
+        output_dir=str(tmp_path / "out"), max_steps=2,
+        logging_steps=1, save_steps=-1,
+        bf16=False, learning_rate=1e-2, **targ_kw,
+    )
+    return Trainer(
+        cfg, params, load_tokenizer("byte"), ModelArguments(),
+        DataArguments(data_path=toy_data, event_folder=SAMPLE_DIR), targs,
+    )
+
+
+def test_freeze_mm_mlp_adapter_stage2(toy_data, tmp_path):
+    tr = _trainer(toy_data, tmp_path, stage=2, freeze_mm_mlp_adapter=True)
+    assert "projector" not in tr.state.trainable
+    assert "projector" in tr.state.frozen
+    metrics = tr.train()
+    assert np.isfinite(metrics["loss"])
+    # LoRA artifact written, projector artifact not.
+    assert os.path.exists(os.path.join(tr.targs.output_dir, "lora_last.npz"))
+    assert not os.path.exists(os.path.join(tr.targs.output_dir, "projector_last.npz"))
+
+
+def test_freeze_mm_mlp_adapter_stage1_rejected(toy_data, tmp_path):
+    with pytest.raises(ValueError, match="nothing"):
+        tr = _trainer(toy_data, tmp_path, stage=1, freeze_mm_mlp_adapter=True)
+        tr.train()
+
+
+def test_lora_weight_path_roundtrip(toy_data, tmp_path):
+    tr = _trainer(toy_data, tmp_path / "a", stage=2)
+    tr.train()
+    lora_npz = os.path.join(tr.targs.output_dir, "lora_last.npz")
+    assert os.path.exists(lora_npz)
+
+    tr2 = _trainer(toy_data, tmp_path / "b", stage=2, lora_weight_path=lora_npz)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(tr.state.trainable["lora"]),
+        jax.tree_util.tree_leaves(tr2.state.trainable["lora"]),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_batch_larger_than_dataset_rejected(toy_data, tmp_path):
+    tr = _trainer(toy_data, tmp_path, stage=1, per_device_train_batch_size=8)
+    with pytest.raises(ValueError, match="zero batches"):
+        tr.train()
