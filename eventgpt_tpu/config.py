@@ -63,6 +63,10 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     max_seq_len: int = 2048  # reference context cap: model/EventChatModel.py:378
     tie_word_embeddings: bool = False
+    # "dense" = materialized-scores attention; "flash" = Pallas fused kernel
+    # for prefill (ops/flash_attention.py). Decode always uses the dense
+    # single-query path against the KV cache.
+    attn_impl: str = "dense"
 
     def resolved_head_dim(self) -> int:
         return self.head_dim if self.head_dim is not None else self.hidden_size // self.num_heads

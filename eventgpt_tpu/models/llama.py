@@ -131,8 +131,13 @@ def _repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
 def _attn_block(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
                 cos: jnp.ndarray, sin: jnp.ndarray,
                 k_full: jnp.ndarray, v_full: jnp.ndarray,
-                mask: jnp.ndarray) -> jnp.ndarray:
-    """Shared attention math. x: (B,Q,D); k/v_full: (B,S,KV,hd); mask: (B,1,Q,S)."""
+                mask: Optional[jnp.ndarray] = None,
+                valid: Optional[jnp.ndarray] = None,
+                use_flash: bool = False) -> jnp.ndarray:
+    """Shared attention plumbing (q proj + RoPE + GQA repeat + o proj) with a
+    score-computation switch: dense additive ``mask`` (B,1,Q,S) or the Pallas
+    flash kernel with a (B,S) ``valid`` padding mask (causal implied).
+    x: (B,Q,D); k/v_full: (B,S,KV,hd)."""
     b, q_len, d = x.shape
     h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.resolved_head_dim()
 
@@ -141,10 +146,16 @@ def _attn_block(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
     k = _repeat_kv(k_full, h // kvh)
     v = _repeat_kv(v_full, h // kvh)
 
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
-    scores = scores * (1.0 / math.sqrt(hd)) + mask
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, q_len, h * hd)
+    if use_flash:
+        from eventgpt_tpu.ops.flash_attention import flash_attention
+
+        ctx = flash_attention(q, k, v, valid=valid, causal=True)
+        ctx = ctx.reshape(b, q_len, h * hd)
+    else:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        scores = scores * (1.0 / math.sqrt(hd)) + mask
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, q_len, h * hd)
     return ctx @ layer["attn"]["o"]
 
 
@@ -181,9 +192,13 @@ def prefill(
     positions = jnp.maximum(positions, 0)
     cos, sin = rope_tables(cfg, positions)
 
-    causal = jnp.tril(jnp.ones((t, t), bool))
-    visible = causal[None, None] & attention_mask[:, None, None, :]
-    mask = jnp.where(visible, 0.0, jnp.finfo(jnp.float32).min)
+    use_flash = cfg.attn_impl == "flash"
+    if use_flash:
+        mask = None  # the kernel applies causal + padding masks inline
+    else:
+        causal = jnp.tril(jnp.ones((t, t), bool))
+        visible = causal[None, None] & attention_mask[:, None, None, :]
+        mask = jnp.where(visible, 0.0, jnp.finfo(jnp.float32).min)
 
     x = inputs_embeds
 
@@ -194,7 +209,9 @@ def prefill(
         k = (y @ layer["attn"]["k"]).reshape(b, t, cfg.num_kv_heads, -1)
         k = apply_rope(k, cos, sin)
         v = (y @ layer["attn"]["v"]).reshape(b, t, cfg.num_kv_heads, -1)
-        h_mid = h_in + _attn_block(cfg, y, layer, cos, sin, k, v, mask)
+        h_mid = h_in + _attn_block(cfg, y, layer, cos, sin, k, v,
+                                   mask=mask, valid=attention_mask,
+                                   use_flash=use_flash)
         y2 = rms_norm(h_mid, layer["post_norm"], cfg.rms_norm_eps)
         h_out = h_mid + _mlp_block(y2, layer)
         return h_out, (k, v)

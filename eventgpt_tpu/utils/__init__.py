@@ -1,0 +1,3 @@
+"""Utilities: profiling, structured metrics."""
+
+from eventgpt_tpu.utils.profiling import profile_trace, timed  # noqa: F401

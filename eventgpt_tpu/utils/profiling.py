@@ -1,0 +1,54 @@
+"""Profiling hooks: jax.profiler traces + host-readback-fenced timing.
+
+The reference has no tracing/profiling at all (SURVEY.md §5 — Timer.h is an
+unshipped external, nvtx a dep only). These are the TPU equivalents:
+
+  * ``profile_trace(logdir)`` — context manager around ``jax.profiler`` so a
+    training/inference region can be inspected in TensorBoard/XProf.
+  * ``timed(fn)`` — wall-clock timing with a host-readback fence; plain
+    ``block_until_ready`` is NOT a reliable fence on tunneled devices (see
+    bench.py), so the fence sums the outputs to force completion.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Tuple
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str):
+    """Capture a jax.profiler trace for the enclosed region."""
+    import jax
+
+    jax.profiler.start_trace(logdir, create_perfetto_link=False)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def _fence(x: Any) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(x):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.number):
+            total += float(jnp.sum(leaf.astype(jnp.float32)))
+    return total
+
+
+def timed(fn: Callable, *args, iters: int = 10, warmup: int = 2) -> Tuple[float, Any]:
+    """(seconds_per_iter, last_output) with compile excluded and a
+    host-readback fence after the timed loop."""
+    out = None
+    for _ in range(max(warmup, 1)):
+        out = fn(*args)
+    _fence(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _fence(out)
+    return (time.perf_counter() - t0) / iters, out

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Training launcher: stage 1 (projector warm-up) or stage 2 (LoRA finetune).
+# Replaces the reference's external LLaVA/DeepSpeed launch (SURVEY.md §2.2):
+# distributed setup is EGPT_COORDINATOR/EGPT_NUM_PROCESSES/EGPT_PROCESS_ID
+# (parallel/dist.py) instead of torchrun/deepspeed.
+set -euo pipefail
+STAGE=${STAGE:-1}
+python -m eventgpt_tpu.cli.train \
+  --model_name_or_path "${MODEL_PATH:-tiny-random}" \
+  --data_path "${DATA_PATH:?set DATA_PATH to the QA json}" \
+  --event_folder "${EVENT_FOLDER:-.}" \
+  --stage "$STAGE" \
+  --output_dir "${OUTPUT_DIR:-./output}" \
+  --per_device_train_batch_size "${BATCH_SIZE:-4}" \
+  --learning_rate "${LR:-2e-3}" \
+  --num_train_epochs "${EPOCHS:-1}" \
+  --warmup_ratio 0.03 \
+  "$@"

@@ -1,0 +1,116 @@
+"""DSEC HDF5 IO tests against a synthetic events.h5 fixture."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+h5py = pytest.importorskip("h5py")
+
+from eventgpt_tpu.data import dsec
+
+
+@pytest.fixture(scope="module")
+def dsec_root(tmp_path_factory):
+    """Synthetic DSEC sequence: 10k events over 100 ms, ms_to_idx, t_offset."""
+    root = tmp_path_factory.mktemp("dsec_seq")
+    ev_dir = root / "events" / "left"
+    ev_dir.mkdir(parents=True)
+    n = 10_000
+    rng = np.random.default_rng(0)
+    t = np.sort(rng.integers(0, 100_000, n)).astype(np.int64)  # µs, relative
+    t_offset = 5_000_000
+    ms = np.arange(101)
+    ms_to_idx = np.searchsorted(t, ms * 1000, side="left")
+    with h5py.File(ev_dir / "events.h5", "w") as f:
+        g = f.create_group("events")
+        g["x"] = rng.integers(0, 640, n).astype(np.uint16)
+        g["y"] = rng.integers(0, 480, n).astype(np.uint16)
+        g["t"] = t
+        g["p"] = rng.integers(0, 2, n).astype(np.uint8)
+        f["t_offset"] = t_offset
+        f["ms_to_idx"] = ms_to_idx
+
+    img_dir = root / "images"
+    img_dir.mkdir()
+    np.savetxt(img_dir / "timestamps.txt", np.arange(5) * 20_000 + t_offset, fmt="%d")
+
+    det_dir = root / "object_detections" / "left"
+    det_dir.mkdir(parents=True)
+    np.save(det_dir / "tracks.npy", np.zeros((3, 4)))
+
+    (root / "QADataset.json").write_text(json.dumps([{"id": 0, "q": "?"}]))
+    return str(root), t, t_offset
+
+
+def test_num_events_and_by_index(dsec_root):
+    root, t, t_offset = dsec_root
+    d = dsec.DSECDirectory(root)
+    assert d.events.num_events() == len(t)
+    ev = d.events.by_index(100, 200)
+    assert len(ev["t"]) == 100
+    np.testing.assert_array_equal(ev["t"], t[100:200] + t_offset)
+
+
+def test_by_timewindow_uses_offset(dsec_root):
+    root, t, t_offset = dsec_root
+    d = dsec.DSECDirectory(root)
+    t_min, t_max = t_offset + 10_000, t_offset + 20_000
+    ev = d.events.by_timewindow(t_min, t_max)
+    # Exact parity with a brute-force filter.
+    want = t[(t >= 10_000) & (t < 20_000)] + t_offset
+    np.testing.assert_array_equal(ev["t"], want)
+    assert (ev["t"] >= t_min).all() and (ev["t"] < t_max).all()
+
+
+def test_timewindow_edges(dsec_root):
+    root, t, t_offset = dsec_root
+    d = dsec.DSECDirectory(root)
+    full = d.events.by_timewindow(t_offset, t_offset + 200_000)
+    assert len(full["t"]) == len(t)
+    empty = d.events.by_timewindow(t_offset + 200_000, t_offset + 300_000)
+    assert len(empty["t"]) == 0
+
+
+def test_directory_accessors(dsec_root):
+    root, _, t_offset = dsec_root
+    d = dsec.DSECDirectory(root)
+    assert len(d.images.timestamps) == 5
+    assert d.images.timestamps[0] == t_offset
+    assert d.tracks.tracks.shape == (3, 4)
+    assert d.labels.qa[0]["id"] == 0
+
+
+def test_h5_file_to_dict_and_compare_dirs(dsec_root, tmp_path):
+    root, t, _ = dsec_root
+    flat = dsec.h5_file_to_dict(os.path.join(root, "events", "left", "events.h5"))
+    assert "events/t" in flat and len(flat["events/t"]) == len(t)
+
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    for d_ in (a, b):
+        d_.mkdir()
+        (d_ / "f.txt").write_text("same")
+    assert dsec.compare_dirs(str(a), str(b))
+    (b / "f.txt").write_text("diff")
+    assert not dsec.compare_dirs(str(a), str(b))
+
+
+def test_timewindow_tail_beyond_ms_table(tmp_path):
+    """Events after the last ms tick must not be dropped (hi clamps to n)."""
+    ev_dir = tmp_path / "events" / "left"
+    ev_dir.mkdir(parents=True)
+    # 50 events at 0..49us, then 5 tail events at 1500..1504us; table covers
+    # only ms 0 and 1, with ms_to_idx[-1]=50 < n=55.
+    t = np.concatenate([np.arange(50), 1500 + np.arange(5)]).astype(np.int64)
+    with h5py.File(ev_dir / "events.h5", "w") as f:
+        g = f.create_group("events")
+        g["x"] = np.zeros(55, np.uint16)
+        g["y"] = np.zeros(55, np.uint16)
+        g["t"] = t
+        g["p"] = np.zeros(55, np.uint8)
+        f["t_offset"] = 0
+        f["ms_to_idx"] = np.searchsorted(t, np.array([0, 1000]))
+    ev = dsec.extract_from_h5_by_timewindow(str(ev_dir / "events.h5"), 0, 2000)
+    assert len(ev["t"]) == 55
