@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from eventgpt_tpu.config import EventChatConfig
 from eventgpt_tpu.models import clip as clip_mod
@@ -143,6 +144,65 @@ def _decode_jit(params, cfg: EventChatConfig, tokens, cache):
     return llama_mod.decode_step(params["llama"], cfg.llama, token_embeds, cache)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "temperature", "top_p", "eos_token_id"),
+    donate_argnames=("cache",),
+)
+def _decode_loop_jit(
+    params,
+    cfg: EventChatConfig,
+    first_logits,
+    cache,
+    key,
+    max_new_tokens: int,
+    temperature: float,
+    top_p: float,
+    eos_token_id: int,
+):
+    """Whole autoregressive loop on device (lax.while_loop): no per-token
+    host sync — the HF generate loop re-entered Python every step
+    (SURVEY.md §3.1 hot loop); here the host reads back once at the end.
+
+    Returns (tokens [B, max_new_tokens] int32, n_generated [B]).
+    Rows that hit EOS are frozen to EOS thereafter.
+    """
+    b = first_logits.shape[0]
+    tokens0 = jnp.zeros((b, max(max_new_tokens, 1)), jnp.int32)
+    done0 = jnp.zeros((b,), bool)
+
+    def cond(state):
+        step, _, done, _, _, _ = state
+        return (step < max_new_tokens) & ~done.all()
+
+    def body(state):
+        step, tokens, done, logits, cache, key = state
+        key, sub = jax.random.split(key)
+        next_tok = sample(logits, sub, temperature, top_p)
+        next_tok = jnp.where(done, eos_token_id, next_tok)
+        tokens = tokens.at[:, step].set(next_tok)
+        done = done | (next_tok == eos_token_id)
+
+        def advance(operands):
+            tok, cch = operands
+            token_embeds = llama_mod.embed_tokens(params["llama"], tok[:, None])
+            return llama_mod.decode_step(params["llama"], cfg.llama, token_embeds, cch)
+
+        # Skip the final forward once every row is done / budget spent.
+        logits, cache = lax.cond(
+            (step + 1 < max_new_tokens) & ~done.all(),
+            advance,
+            lambda operands: (logits, operands[1]),
+            (next_tok, cache),
+        )
+        return step + 1, tokens, done, logits, cache, key
+
+    step, tokens, done, _, cache, _ = lax.while_loop(
+        cond, body, (jnp.int32(0), tokens0, done0, first_logits, cache, key)
+    )
+    return tokens[:, :max_new_tokens], step
+
+
 def generate(
     params: Params,
     cfg: EventChatConfig,
@@ -188,26 +248,22 @@ def generate(
     last_logits = logits[jnp.arange(b), lens - 1]
 
     key = jax.random.PRNGKey(seed)
-    out_tokens = np.zeros((b, max_new_tokens), np.int32)
-    done = np.zeros((b,), bool)
-    num_steps = 0
-
-    for step in range(max_new_tokens):
-        key, sub = jax.random.split(key)
-        next_tok = sample(last_logits, sub, temperature, top_p)
-        tok_host = np.asarray(next_tok)
-        out_tokens[:, step] = tok_host
-        num_steps = step + 1
-        done |= (tok_host == eos_token_id) if eos_token_id is not None else False
-        if done.all() or step == max_new_tokens - 1:
-            break  # skip the forward pass whose logits would never be used
-        last_logits, cache = _decode_jit(params, cfg, next_tok, cache)
+    if max_new_tokens == 0:
+        return [[] for _ in range(b)]
+    # EOS sentinel: a real id stops rows early; None decodes the full budget
+    # (an out-of-vocab sentinel that never matches a sampled token).
+    eos = eos_token_id if eos_token_id is not None else -1
+    tokens, num_steps = _decode_loop_jit(
+        params, cfg, last_logits, cache, key,
+        max_new_tokens, float(temperature), float(top_p), int(eos),
+    )
+    out_tokens = np.asarray(jax.device_get(tokens))  # single host readback
+    num_steps = int(num_steps)
 
     results: List[List[int]] = []
     for i in range(b):
-        row = out_tokens[i]
         ids: List[int] = []
-        for tid in row[:num_steps]:
+        for tid in out_tokens[i, :num_steps]:
             if eos_token_id is not None and tid == eos_token_id:
                 break
             ids.append(int(tid))
