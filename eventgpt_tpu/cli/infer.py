@@ -58,12 +58,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--dtype", type=str, default="bfloat16",
                    choices=["bfloat16", "float32"])
+    p.add_argument("--attn_impl", type=str, default=None,
+                   choices=["dense", "flash"],
+                   help="prefill attention kernel (default: flash on TPU)")
+    p.add_argument("--quant", type=str, default="none", choices=["none", "int8"],
+                   help="weight-only quantization of the LM matmuls")
     p.add_argument("--timing", action="store_true", help="print stage timings to stderr")
     return p
 
 
-def load_model(model_path: str, dtype: str):
-    """Returns (config, params, tokenizer)."""
+def load_model(model_path: str, dtype: str, attn_impl=None):
+    """Returns (config, host-or-device params, tokenizer).
+
+    HF-checkpoint params stay host-resident (numpy) so downstream transforms
+    (embedding resize, int8 quantization) run before anything hits HBM —
+    quantizing a 7B tree on-device would need bf16 + int8 + f32 temps
+    simultaneously. ``place_params`` does the final device put.
+    """
     import jax.numpy as jnp
 
     jdt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
@@ -75,12 +86,25 @@ def load_model(model_path: str, dtype: str):
 
     with open(os.path.join(model_path, "config.json")) as f:
         hf_cfg = json.load(f)
-    cfg = from_hf_config(hf_cfg)
+    cfg = from_hf_config(hf_cfg, attn_impl=attn_impl)
     sd = convert.load_state_dict(model_path)
     params = convert.eventchat_params_from_hf(sd, cfg)
-    params = jax.tree_util.tree_map(lambda x: jax.numpy.asarray(x, jdt), params)
     tokenizer = load_tokenizer(model_path)
     return cfg, params, tokenizer
+
+
+def place_params(tree, jdt):
+    """Host tree -> device, compute floats in ``jdt``; quantized leaves keep
+    int8 payloads and f32 scales."""
+    import jax.numpy as jnp
+
+    from eventgpt_tpu.ops import quant as quant_mod
+
+    if quant_mod.is_quantized(tree):
+        return {"q": jnp.asarray(tree["q"]), "s": jnp.asarray(tree["s"], jnp.float32)}
+    if isinstance(tree, dict):
+        return {k: place_params(v, jdt) for k, v in tree.items()}
+    return jnp.asarray(tree, jdt)
 
 
 def main(argv=None) -> str:
@@ -89,7 +113,7 @@ def main(argv=None) -> str:
         raise NotImplementedError("beam search is not supported; use sampling or greedy")
 
     t0 = time.perf_counter()
-    cfg, params, tokenizer = load_model(args.model_path, args.dtype)
+    cfg, params, tokenizer = load_model(args.model_path, args.dtype, args.attn_impl)
     if args.spatial_temporal_encoder != cfg.use_spatio_temporal_pool:
         import dataclasses
 
@@ -105,6 +129,18 @@ def main(argv=None) -> str:
         )
     if len(tokenizer) > cfg.llama.vocab_size:
         params["llama"] = resize_token_embeddings(params["llama"], len(tokenizer))
+    if args.quant == "int8":
+        # After embedding resize — quantized leaves are {"q","s"} dicts that
+        # resize_token_embeddings cannot grow. Host-side: never holds the
+        # bf16 and int8 trees in HBM together.
+        from eventgpt_tpu.ops.quant import quantize_llama_params
+
+        params["llama"] = quantize_llama_params(
+            jax.tree_util.tree_map(np.asarray, params["llama"]), host=True
+        )
+    import jax.numpy as jnp
+
+    params = place_params(params, jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32)
     t_load = time.perf_counter() - t0
 
     t0 = time.perf_counter()

@@ -133,9 +133,13 @@ def _pad_batch(embeds: List[jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray, np.
     return padded, mask, lens
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def _prefill_jit(params, cfg: EventChatConfig, embeds, mask, cache):
-    return llama_mod.prefill(params["llama"], cfg.llama, embeds, mask, cache)
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "last_only"), donate_argnames=("cache",)
+)
+def _prefill_jit(params, cfg: EventChatConfig, embeds, mask, cache, last_only=False):
+    return llama_mod.prefill(
+        params["llama"], cfg.llama, embeds, mask, cache, last_only=last_only
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
@@ -183,17 +187,13 @@ def _decode_loop_jit(
         tokens = tokens.at[:, step].set(next_tok)
         done = done | (next_tok == eos_token_id)
 
-        def advance(operands):
-            tok, cch = operands
-            token_embeds = llama_mod.embed_tokens(params["llama"], tok[:, None])
-            return llama_mod.decode_step(params["llama"], cfg.llama, token_embeds, cch)
-
-        # Skip the final forward once every row is done / budget spent.
-        logits, cache = lax.cond(
-            (step + 1 < max_new_tokens) & ~done.all(),
-            advance,
-            lambda operands: (logits, operands[1]),
-            (next_tok, cache),
+        # Unconditional advance: a lax.cond pass-through branch here would
+        # break XLA's aliasing of the donated KV cache through the
+        # while_loop (a second full cache copy stays live — 3 GB at B=8).
+        # The cost is one trailing decode_step past the stop condition.
+        token_embeds = llama_mod.embed_tokens(params["llama"], next_tok[:, None])
+        logits, cache = llama_mod.decode_step(
+            params["llama"], cfg.llama, token_embeds, cache
         )
         return step + 1, tokens, done, logits, cache, key
 
@@ -244,8 +244,7 @@ def generate(
     max_len = ((max_len + bucket - 1) // bucket) * bucket
     cache = llama_mod.init_kv_cache(cfg.llama, b, max_len, dtype=compute_dtype)
 
-    logits, cache = _prefill_jit(params, cfg, padded, mask, cache)
-    last_logits = logits[jnp.arange(b), lens - 1]
+    last_logits, cache = _prefill_jit(params, cfg, padded, mask, cache, True)
 
     key = jax.random.PRNGKey(seed)
     if max_new_tokens == 0:

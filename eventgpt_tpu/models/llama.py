@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from eventgpt_tpu.config import LlamaConfig
+from eventgpt_tpu.ops.quant import matmul as _mm, matmul_f32_out as _mm_f32
 
 Params = Dict[str, Any]
 KVCache = Dict[str, jnp.ndarray]  # {"k": [L,B,S,KV,hd], "v": [L,B,S,KV,hd], "length": [B]}
@@ -141,7 +142,7 @@ def _attn_block(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
     b, q_len, d = x.shape
     h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.resolved_head_dim()
 
-    q = (x @ layer["attn"]["q"]).reshape(b, q_len, h, hd)
+    q = _mm(x, layer["attn"]["q"]).reshape(b, q_len, h, hd)
     q = apply_rope(q, cos, sin)
     k = _repeat_kv(k_full, h // kvh)
     v = _repeat_kv(v_full, h // kvh)
@@ -156,12 +157,12 @@ def _attn_block(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
         scores = scores * (1.0 / math.sqrt(hd)) + mask
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, q_len, h * hd)
-    return ctx @ layer["attn"]["o"]
+    return _mm(ctx, layer["attn"]["o"])
 
 
 def _mlp_block(x: jnp.ndarray, layer: Params) -> jnp.ndarray:
-    gate = jax.nn.silu(x @ layer["mlp"]["gate"])
-    return (gate * (x @ layer["mlp"]["up"])) @ layer["mlp"]["down"]
+    gate = jax.nn.silu(_mm(x, layer["mlp"]["gate"]))
+    return _mm(gate * _mm(x, layer["mlp"]["up"]), layer["mlp"]["down"])
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.bfloat16) -> KVCache:
@@ -180,12 +181,18 @@ def prefill(
     inputs_embeds: jnp.ndarray,
     attention_mask: jnp.ndarray,
     cache: KVCache,
+    last_only: bool = False,
 ) -> Tuple[jnp.ndarray, KVCache]:
-    """Run the full prompt; returns (logits [B, T, V], filled cache).
+    """Run the full prompt; returns (logits, filled cache).
 
     ``attention_mask`` is bool (B, T): True = real token, False = right pad.
     The prompt occupies cache slots [0, T); cache["length"] records the true
     per-row prompt length for the decode phase.
+
+    ``last_only=False`` -> logits (B, T, V) (training/eval). ``last_only=True``
+    -> logits (B, V) at each row's final real token — the only position
+    ``generate`` consumes; skipping the other T-1 lm_head columns saves
+    T x vocab f32 per row (0.66 GB at B=8, S=640).
     """
     b, t, d = inputs_embeds.shape
     positions = jnp.cumsum(attention_mask.astype(jnp.int32), axis=1) - 1
@@ -206,9 +213,9 @@ def prefill(
         layer, = xs
         h_in = carry
         y = rms_norm(h_in, layer["input_norm"], cfg.rms_norm_eps)
-        k = (y @ layer["attn"]["k"]).reshape(b, t, cfg.num_kv_heads, -1)
+        k = _mm(y, layer["attn"]["k"]).reshape(b, t, cfg.num_kv_heads, -1)
         k = apply_rope(k, cos, sin)
-        v = (y @ layer["attn"]["v"]).reshape(b, t, cfg.num_kv_heads, -1)
+        v = _mm(y, layer["attn"]["v"]).reshape(b, t, cfg.num_kv_heads, -1)
         h_mid = h_in + _attn_block(cfg, y, layer, cos, sin, k, v,
                                    mask=mask, valid=attention_mask,
                                    use_flash=use_flash)
@@ -216,17 +223,24 @@ def prefill(
         h_out = h_mid + _mlp_block(y2, layer)
         return h_out, (k, v)
 
-    x, (k_all, v_all) = lax.scan(block, x, (params["layers"],))
+    block_fn = jax.checkpoint(block, prevent_cse=False) if cfg.remat else block
+    x, (k_all, v_all) = lax.scan(block_fn, x, (params["layers"],))
 
-    max_len = cache["k"].shape[2]
-    pad = max_len - t
+    # In-place slot write (aliases the donated cache buffers; jnp.pad here
+    # would materialize a second full-size cache copy).
+    lengths = attention_mask.astype(jnp.int32).sum(axis=1)
     new_cache = {
-        "k": jnp.pad(k_all.astype(cache["k"].dtype), ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
-        "v": jnp.pad(v_all.astype(cache["v"].dtype), ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
-        "length": attention_mask.astype(jnp.int32).sum(axis=1),
+        "k": cache["k"].at[:, :, :t].set(k_all.astype(cache["k"].dtype)),
+        "v": cache["v"].at[:, :, :t].set(v_all.astype(cache["v"].dtype)),
+        "length": lengths,
     }
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    logits = jnp.matmul(x, params["lm_head"], preferred_element_type=jnp.float32)
+    if last_only:
+        last = jnp.take_along_axis(
+            x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+        )[:, 0]  # (B, D)
+        return _mm_f32(last, params["lm_head"]), new_cache
+    logits = _mm_f32(x, params["lm_head"])
     return logits, new_cache
 
 
@@ -256,9 +270,9 @@ def decode_step(
         layer, k_cache, v_cache = xs
         h_in = carry
         y = rms_norm(h_in, layer["input_norm"], cfg.rms_norm_eps)
-        k_new = (y @ layer["attn"]["k"]).reshape(b, 1, cfg.num_kv_heads, -1)
+        k_new = _mm(y, layer["attn"]["k"]).reshape(b, 1, cfg.num_kv_heads, -1)
         k_new = apply_rope(k_new, cos, sin)
-        v_new = (y @ layer["attn"]["v"]).reshape(b, 1, cfg.num_kv_heads, -1)
+        v_new = _mm(y, layer["attn"]["v"]).reshape(b, 1, cfg.num_kv_heads, -1)
         k_cache = k_cache.at[batch_idx, slot].set(k_new[:, 0].astype(k_cache.dtype))
         v_cache = v_cache.at[batch_idx, slot].set(v_new[:, 0].astype(v_cache.dtype))
         h_mid = h_in + _attn_block(cfg, y, layer, cos, sin,
@@ -270,7 +284,7 @@ def decode_step(
     x, (k_all, v_all) = lax.scan(block, token_embeds, (params["layers"], cache["k"], cache["v"]))
     new_cache = {"k": k_all, "v": v_all, "length": cache["length"] + 1}
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    logits = jnp.matmul(x[:, 0], params["lm_head"], preferred_element_type=jnp.float32)
+    logits = _mm_f32(x[:, 0], params["lm_head"])
     return logits, new_cache
 
 

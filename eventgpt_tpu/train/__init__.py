@@ -16,4 +16,4 @@ from eventgpt_tpu.train.optim import (  # noqa: F401
     step_decay,
     make_optimizer,
 )
-from eventgpt_tpu.train.lora import init_lora_params, merge_lora  # noqa: F401
+from eventgpt_tpu.train.lora import apply_lora, init_lora_params, merge_lora  # noqa: F401

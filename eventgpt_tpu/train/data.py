@@ -358,3 +358,41 @@ def batch_iterator(
     for i in range(0, end, batch_size):
         idxs = order[i:i + batch_size]
         yield collate_fixed_layout([dataset[int(j)] for j in idxs], cfg, max_len=max_len)
+
+
+def synthetic_multimodal_batch(
+    cfg: EventChatConfig,
+    batch: int,
+    seq: int,
+    event_offset: int = 35,
+    pixel_values: Optional[np.ndarray] = None,
+    mask_event_labels: bool = False,
+) -> Dict[str, np.ndarray]:
+    """Fixed-layout batch with one event block per row, synthetic text ids.
+
+    The single source of the fixed-layout invariant for harnesses that don't
+    run the tokenizer (driver dry runs, benchmarks): text ids surround an
+    ``num_event_tokens`` event slot block starting at ``event_offset``, with
+    the gather-index map ``collate_fixed_layout`` would produce.
+    """
+    e = cfg.num_event_tokens
+    if event_offset + e >= seq:
+        raise ValueError(f"seq={seq} too small for {e} event tokens at offset {event_offset}")
+    token_ids = np.zeros((batch, seq), np.int32)
+    token_ids[:, :event_offset] = 7
+    token_ids[:, event_offset + e:] = 9
+    attn = np.ones((batch, seq), bool)
+    pos = np.zeros((batch, seq), bool)
+    pos[:, event_offset:event_offset + e] = True
+    eidx = np.clip(np.arange(seq) - event_offset, 0, e - 1)[None].repeat(batch, 0)
+    if pixel_values is None:
+        pixel_values = np.zeros(
+            (batch, cfg.num_event_frames, cfg.vision.num_channels,
+             cfg.vision.image_size, cfg.vision.image_size), np.float32,
+        )
+    labels = np.where(pos if mask_event_labels else ~attn, IGNORE_INDEX, token_ids)
+    return {
+        "token_ids": token_ids, "labels": labels.astype(np.int32),
+        "attn_mask": attn, "event_pos": pos,
+        "event_index": eidx.astype(np.int32), "pixel_values": pixel_values,
+    }

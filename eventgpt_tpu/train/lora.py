@@ -87,6 +87,34 @@ def init_lora_params(
     return out
 
 
+def apply_lora(base_llama: Params, lora_params: Params, lora: LoraConfig) -> Params:
+    """Frozen base + trainable LoRA -> effective LLaMA tree with *composite*
+    weight leaves ``{"w": base, "a": A*scale, "b": B}`` that the matmul
+    dispatch in ``ops/quant.py`` evaluates as ``x@w + (x@a)@b``.
+
+    Unlike ``merge_lora`` this never materializes the (K, N) delta — at 7B a
+    merged copy of every target weight is ~13 GB, more than a v5e chip's
+    HBM; apply-form adds only the rank-r factors. Gradients w.r.t.
+    ``lora_params`` flow through the two skinny matmuls; the base leaves
+    enter as constants.
+    """
+    scale = lora.scaling
+    layers = base_llama["layers"]
+    new_layers = {**layers}
+    for group in ("attn", "mlp"):
+        if group not in lora_params or not lora_params[group]:
+            continue
+        new_group = {**layers[group]}
+        for name, ab in lora_params[group].items():
+            new_group[name] = {
+                "w": layers[group][name],
+                "a": ab["a"] * scale,
+                "b": ab["b"],
+            }
+        new_layers[group] = new_group
+    return {**base_llama, "layers": new_layers}
+
+
 def merge_lora(base_llama: Params, lora_params: Params, lora: LoraConfig) -> Params:
     """Frozen base + trainable LoRA -> effective LLaMA params (same tree).
 

@@ -5,9 +5,10 @@ MLP (+ feature adaptor) trains — the reference implements this by detaching
 the CLIP output and re-enabling grad (``model/EventChatModel.py:185-191``);
 here the boundary is simply which pytree is differentiated.
 
-Stage 2 (LoRA finetune): the LM is adapted through a LoRA tree merged into
-the frozen base weights inside the step (``train/lora.py``); the projector
-keeps training with its own LR group (``mm_projector_lr``).
+Stage 2 (LoRA finetune): the LM is adapted through an apply-form LoRA tree
+(``x@W + (x@A)@B`` composite leaves, ``train/lora.py:apply_lora``) so the
+frozen base weights are never copied; the projector keeps training with its
+own LR group (``mm_projector_lr``).
 
 Both steps consume the fixed-layout batches of ``train/data.py``: the
 embedding splice is a static-shape ``take_along_axis`` + ``where`` — the
@@ -32,7 +33,7 @@ import optax
 from eventgpt_tpu.config import EventChatConfig
 from eventgpt_tpu.constants import IGNORE_INDEX
 from eventgpt_tpu.models import eventchat, llama as llama_mod
-from eventgpt_tpu.train.lora import LoraConfig, merge_lora
+from eventgpt_tpu.train.lora import LoraConfig, apply_lora
 
 Params = Dict[str, Any]
 Batch = Dict[str, jnp.ndarray]
@@ -93,7 +94,7 @@ def make_stage2_combine(lora_cfg: LoraConfig) -> Callable[[Params, Params], Para
         return {
             "clip": frozen["clip"],
             "projector": trainable["projector"],
-            "llama": merge_lora(frozen["llama"], trainable["lora"], lora_cfg),
+            "llama": apply_lora(frozen["llama"], trainable["lora"], lora_cfg),
         }
 
     return combine

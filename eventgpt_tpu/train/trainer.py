@@ -116,10 +116,10 @@ class Trainer:
                 lcfg = self.lora_cfg
 
                 def combine(tr, fz, _lcfg=lcfg):
-                    from eventgpt_tpu.train.lora import merge_lora
+                    from eventgpt_tpu.train.lora import apply_lora
 
                     return {"clip": fz["clip"], "projector": fz["projector"],
-                            "llama": merge_lora(fz["llama"], tr["lora"], _lcfg)}
+                            "llama": apply_lora(fz["llama"], tr["lora"], _lcfg)}
 
                 self.combine = combine
             else:

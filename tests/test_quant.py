@@ -1,0 +1,88 @@
+"""Weight-only int8 quantization: numerics + end-to-end decode parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgpt_tpu.config import LlamaConfig
+from eventgpt_tpu.models import llama as llama_mod
+from eventgpt_tpu.ops import quant
+
+
+def test_quantize_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 48), jnp.float32)
+    q = quant.quantize_tensor(w)
+    assert q["q"].dtype == jnp.int8
+    assert q["s"].shape == (1, 48)
+    deq = quant.dequantize_tensor(q)
+    # Max error per element is half a quantization step (scale/2).
+    step = np.asarray(q["s"])[0]
+    err = np.abs(np.asarray(deq) - np.asarray(w))
+    assert (err <= step / 2 + 1e-6).all()
+
+
+def test_quantized_matmul_close():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.random.normal(k1, (4, 64), jnp.float32)
+    w = jax.random.normal(k2, (64, 32), jnp.float32)
+    y_ref = x @ w
+    y_q = quant.matmul(x, quant.quantize_tensor(w))
+    # int8 per-channel weight quantization over K=64 contractions: ~1%
+    # mean relative error (per-element quant noise max|w|/127/sqrt(12),
+    # accumulated over sqrt(K)).
+    rel = np.abs(np.asarray(y_q - y_ref)) / (np.abs(np.asarray(y_ref)) + 1.0)
+    assert rel.mean() < 2e-2
+
+
+def test_stacked_layer_quantization_shapes():
+    w = jax.random.normal(jax.random.PRNGKey(2), (3, 16, 8), jnp.float32)
+    q = quant.quantize_tensor(w)
+    assert q["q"].shape == (3, 16, 8)
+    assert q["s"].shape == (3, 1, 8)
+    # Per-layer slices must equal quantizing each layer independently.
+    q0 = quant.quantize_tensor(w[0])
+    np.testing.assert_array_equal(np.asarray(q["q"][0]), np.asarray(q0["q"]))
+
+
+def test_quantized_llama_forward_close():
+    cfg = LlamaConfig.tiny()
+    params = llama_mod.init_llama_params(cfg, jax.random.PRNGKey(0))
+    qparams = quant.quantize_llama_params(params)
+    assert qparams["layers"]["attn"]["q"]["q"].dtype == jnp.int8
+    # Embeddings/norms stay dense.
+    assert not quant.is_quantized(qparams["embed_tokens"])
+    assert not quant.is_quantized(qparams["layers"]["input_norm"])
+
+    embeds = llama_mod.embed_tokens(params, jnp.arange(24).reshape(2, 12))
+    logits_ref = llama_mod.forward(params, cfg, embeds)
+    logits_q = llama_mod.forward(qparams, cfg, embeds)
+    # Same argmax on nearly every position; logits close.
+    agree = (np.asarray(logits_ref.argmax(-1)) == np.asarray(logits_q.argmax(-1))).mean()
+    assert agree > 0.9
+    assert np.abs(np.asarray(logits_q - logits_ref)).mean() < 0.05 * np.abs(
+        np.asarray(logits_ref)
+    ).mean() + 0.05
+
+
+def test_quantized_decode_matches_quantized_prefill():
+    """Prefill-then-decode under int8 agrees with one-shot prefill (the same
+    invariant the bf16 path tests), proving the cache path handles the
+    quantized tree."""
+    cfg = LlamaConfig.tiny()
+    params = quant.quantize_llama_params(
+        llama_mod.init_llama_params(cfg, jax.random.PRNGKey(3))
+    )
+    ids = jnp.arange(10)[None]
+    embeds = llama_mod.embed_tokens(params, ids)
+    mask = jnp.ones((1, 10), bool)
+
+    cache = llama_mod.init_kv_cache(cfg, 1, 16, jnp.float32)
+    logits_all, cache = llama_mod.prefill(params, cfg, embeds[:, :9], mask[:, :9], cache)
+    step_logits, _ = llama_mod.decode_step(
+        params, cfg, embeds[:, 9:10], cache
+    )
+    full = llama_mod.forward(params, cfg, embeds, mask)
+    np.testing.assert_allclose(
+        np.asarray(step_logits[0]), np.asarray(full[0, -1]), rtol=2e-4, atol=2e-4
+    )

@@ -145,6 +145,31 @@ def test_lora_dropout_rejected():
         LoraConfig(dropout=0.1)
 
 
+def test_apply_lora_matches_merge_lora(tiny):
+    """Apply-form (composite leaves, no delta materialization) and merge-form
+    produce the same logits for nonzero A/B."""
+    from eventgpt_tpu.models import llama as llama_mod
+    from eventgpt_tpu.train.lora import apply_lora
+
+    cfg, params = tiny
+    lcfg = LoraConfig(r=4)
+    lora = init_lora_params(cfg.llama, lcfg, jax.random.PRNGKey(1))
+    # Make B nonzero so the delta actually participates.
+    lora = jax.tree_util.tree_map(
+        lambda x: x + 0.05 * jnp.ones_like(x), lora
+    )
+    embeds = llama_mod.embed_tokens(params["llama"], jnp.arange(12)[None])
+    out_merge = llama_mod.forward(
+        merge_lora(params["llama"], lora, lcfg), cfg.llama, embeds
+    )
+    out_apply = llama_mod.forward(
+        apply_lora(params["llama"], lora, lcfg), cfg.llama, embeds
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_apply), np.asarray(out_merge), rtol=2e-4, atol=2e-4
+    )
+
+
 def _train_some_steps(cfg, params, tokenizer, stage, n_steps=4):
     samples = _mk_samples(cfg, tokenizer, 2)
     host = data_mod.collate_fixed_layout(samples, cfg, bucket=8)
