@@ -106,10 +106,20 @@ def save_component(path: str, tree: Params, prefix: str = "") -> None:
 def load_component(path: str, strip_prefix: str = "") -> Params:
     """Load an npz component, rewriting keys by stripping ``strip_prefix`` —
     the semantics of the reference's partial ``torch.load`` +
-    ``startswith/replace`` key surgery (``model/EventChatModel.py:124-139``)."""
+    ``startswith/replace`` key surgery (``model/EventChatModel.py:124-139``).
+
+    Keys that do not carry ``strip_prefix`` are rejected loudly (ADVICE r1:
+    passing them through silently injects foreign leaves that only surface
+    later as a tree-structure mismatch); the reference's startswith filter
+    likewise ignores everything else.
+    """
     with np.load(path) as data:
         flat = {}
         for k in data.files:
-            key = k[len(strip_prefix):] if strip_prefix and k.startswith(strip_prefix) else k
-            flat[key] = data[k]
+            if strip_prefix and not k.startswith(strip_prefix):
+                raise ValueError(
+                    f"component file {path} holds key {k!r} without the "
+                    f"expected prefix {strip_prefix!r} — wrong artifact?"
+                )
+            flat[k[len(strip_prefix):] if strip_prefix else k] = data[k]
     return _unflatten(flat)

@@ -44,6 +44,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="EventGPT-TPU inference")
     p.add_argument("--model_path", type=str, required=True)
     p.add_argument("--model_base", type=str, default=None)
+    p.add_argument("--tokenizer_path", type=str, default=None,
+                   help="tokenizer assets dir (default: model_path; 'byte' = "
+                        "offline byte tokenizer)")
     p.add_argument("--query", type=str, required=True)
     p.add_argument("--conv_mode", type=str, default="eventgpt_v1")
     p.add_argument("--sep", type=str, default=",")
@@ -67,7 +70,7 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def load_model(model_path: str, dtype: str, attn_impl=None):
+def load_model(model_path: str, dtype: str, attn_impl=None, tokenizer_path=None):
     """Returns (config, host-or-device params, tokenizer).
 
     HF-checkpoint params stay host-resident (numpy) so downstream transforms
@@ -89,7 +92,7 @@ def load_model(model_path: str, dtype: str, attn_impl=None):
     cfg = from_hf_config(hf_cfg, attn_impl=attn_impl)
     sd = convert.load_state_dict(model_path)
     params = convert.eventchat_params_from_hf(sd, cfg)
-    tokenizer = load_tokenizer(model_path)
+    tokenizer = load_tokenizer(tokenizer_path or model_path)
     return cfg, params, tokenizer
 
 
@@ -104,16 +107,20 @@ def place_params(tree, jdt):
         return {"q": jnp.asarray(tree["q"]), "s": jnp.asarray(tree["s"], jnp.float32)}
     if isinstance(tree, dict):
         return {k: place_params(v, jdt) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(place_params(v, jdt) for v in tree)
     return jnp.asarray(tree, jdt)
 
 
 def main(argv=None) -> str:
     args = build_parser().parse_args(argv)
-    if args.num_beams != 1:
-        raise NotImplementedError("beam search is not supported; use sampling or greedy")
+    if args.num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {args.num_beams}")
 
     t0 = time.perf_counter()
-    cfg, params, tokenizer = load_model(args.model_path, args.dtype, args.attn_impl)
+    cfg, params, tokenizer = load_model(
+        args.model_path, args.dtype, args.attn_impl, args.tokenizer_path
+    )
     if args.spatial_temporal_encoder != cfg.use_spatio_temporal_pool:
         import dataclasses
 
@@ -161,6 +168,7 @@ def main(argv=None) -> str:
         eos_token_id=getattr(tokenizer, "eos_token_id", None),
         seed=args.seed,
         max_context=args.context_len,
+        num_beams=args.num_beams,
     )[0]
     t_gen = time.perf_counter() - t0
 

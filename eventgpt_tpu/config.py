@@ -251,13 +251,28 @@ def from_hf_config(hf: dict, attn_impl: Optional[str] = None) -> EventChatConfig
         max_seq_len=min(hf.get("max_position_embeddings", 2048), 4096),
         tie_word_embeddings=hf.get("tie_word_embeddings", False),
     )
+    # The reference identifies its tower by name only (``mm_visual_tower`` ->
+    # CLIP ViT-L/14-336, README.md:173-177); an explicit "vision_config" dict
+    # (this framework's extension, written by its own config exports)
+    # overrides the dims — e.g. tiny synthetic checkpoints in tests.
+    if isinstance(hf.get("vision_config"), dict):
+        # Filter to known fields: HF-style vision_config dicts carry foreign
+        # keys (model_type, projection_dim, ...) that must not crash the load.
+        known = {f.name for f in dataclasses.fields(VisionConfig)}
+        vision = VisionConfig(
+            **{k: v for k, v in hf["vision_config"].items() if k in known}
+        )
+    else:
+        vision = VisionConfig()
     # Presence of the key — not its value — gates the adaptor, matching the
     # reference's hasattr() check at model/EventChatModel.py:75-76.
     proj = ProjectorConfig(
+        input_dim=vision.hidden_size,
         output_dim=llama.hidden_size,
         use_feature_adaptor="event_feature_adaptor" in hf,
     )
     return EventChatConfig(
+        vision=vision,
         llama=llama,
         projector=proj,
         use_spatio_temporal_pool=hf.get("spatial_temporal_encoder", True),

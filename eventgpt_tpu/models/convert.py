@@ -152,6 +152,128 @@ def eventchat_params_from_hf(sd: StateDict, cfg: EventChatConfig) -> Params:
 
 
 # ---------------------------------------------------------------------------
+# JAX -> HF export (inverse of the readers above; used to publish checkpoints
+# a reference-stack user can load, and to synthesize real-format checkpoint
+# directories in tests)
+
+
+def clip_params_to_hf(params: Params, cfg: VisionConfig,
+                      prefix: str = "vision_model.") -> StateDict:
+    sd: StateDict = {}
+    emb = params["embeddings"]
+    d = cfg.hidden_size
+    sd[prefix + "embeddings.class_embedding"] = np.asarray(emb["class_embedding"])
+    sd[prefix + "embeddings.patch_embedding.weight"] = np.ascontiguousarray(
+        np.asarray(emb["patch_embedding"]).T
+    ).reshape(d, cfg.num_channels, cfg.patch_size, cfg.patch_size)
+    sd[prefix + "embeddings.position_embedding.weight"] = np.asarray(emb["position_embedding"])
+    sd[prefix + "pre_layrnorm.weight"] = np.asarray(params["pre_layernorm"]["scale"])
+    sd[prefix + "pre_layrnorm.bias"] = np.asarray(params["pre_layernorm"]["bias"])
+    L = params["layers"]
+    pairs = [
+        ("layer_norm1.weight", L["ln1"]["scale"], False),
+        ("layer_norm1.bias", L["ln1"]["bias"], False),
+        ("self_attn.q_proj.weight", L["attn"]["q"]["kernel"], True),
+        ("self_attn.q_proj.bias", L["attn"]["q"]["bias"], False),
+        ("self_attn.k_proj.weight", L["attn"]["k"]["kernel"], True),
+        ("self_attn.k_proj.bias", L["attn"]["k"]["bias"], False),
+        ("self_attn.v_proj.weight", L["attn"]["v"]["kernel"], True),
+        ("self_attn.v_proj.bias", L["attn"]["v"]["bias"], False),
+        ("self_attn.out_proj.weight", L["attn"]["o"]["kernel"], True),
+        ("self_attn.out_proj.bias", L["attn"]["o"]["bias"], False),
+        ("layer_norm2.weight", L["ln2"]["scale"], False),
+        ("layer_norm2.bias", L["ln2"]["bias"], False),
+        ("mlp.fc1.weight", L["mlp"]["fc1"]["kernel"], True),
+        ("mlp.fc1.bias", L["mlp"]["fc1"]["bias"], False),
+        ("mlp.fc2.weight", L["mlp"]["fc2"]["kernel"], True),
+        ("mlp.fc2.bias", L["mlp"]["fc2"]["bias"], False),
+    ]
+    for i in range(cfg.num_layers):
+        for name, stacked, transpose in pairs:
+            row = np.asarray(stacked[i])
+            sd[f"{prefix}encoder.layers.{i}.{name}"] = _t(row) if transpose else row
+    sd[prefix + "post_layernorm.weight"] = np.asarray(params["post_layernorm"]["scale"])
+    sd[prefix + "post_layernorm.bias"] = np.asarray(params["post_layernorm"]["bias"])
+    return sd
+
+
+def llama_params_to_hf(params: Params, cfg: LlamaConfig, prefix: str = "model.") -> StateDict:
+    sd: StateDict = {}
+    sd[prefix + "embed_tokens.weight"] = np.asarray(params["embed_tokens"])
+    L = params["layers"]
+    names = [
+        ("layers.{}.input_layernorm.weight", L["input_norm"], False),
+        ("layers.{}.self_attn.q_proj.weight", L["attn"]["q"], True),
+        ("layers.{}.self_attn.k_proj.weight", L["attn"]["k"], True),
+        ("layers.{}.self_attn.v_proj.weight", L["attn"]["v"], True),
+        ("layers.{}.self_attn.o_proj.weight", L["attn"]["o"], True),
+        ("layers.{}.post_attention_layernorm.weight", L["post_norm"], False),
+        ("layers.{}.mlp.gate_proj.weight", L["mlp"]["gate"], True),
+        ("layers.{}.mlp.up_proj.weight", L["mlp"]["up"], True),
+        ("layers.{}.mlp.down_proj.weight", L["mlp"]["down"], True),
+    ]
+    for i in range(cfg.num_layers):
+        for fmt, stacked, transpose in names:
+            row = np.asarray(stacked[i])
+            sd[prefix + fmt.format(i)] = _t(row) if transpose else row
+    sd[prefix + "norm.weight"] = np.asarray(params["final_norm"])
+    sd["lm_head.weight"] = _t(np.asarray(params["lm_head"]))
+    return sd
+
+
+def projector_params_to_hf(params: Params,
+                           prefix: str = "model.visual_projector.",
+                           adaptor_prefix: str = "model.feature_adaptor.") -> StateDict:
+    sd: StateDict = {}
+    for j, layer in enumerate(params["mlp"]):
+        sd[f"{prefix}{2 * j}.weight"] = _t(np.asarray(layer["kernel"]))
+        sd[f"{prefix}{2 * j}.bias"] = np.asarray(layer["bias"])
+    if "adaptor" in params:
+        sd[adaptor_prefix + "weight"] = _t(np.asarray(params["adaptor"]["kernel"]))
+        sd[adaptor_prefix + "bias"] = np.asarray(params["adaptor"]["bias"])
+    return sd
+
+
+def eventchat_params_to_hf(params: Params, cfg: EventChatConfig) -> StateDict:
+    """{clip, projector, llama} pytree -> reference-layout state dict
+    (prefix conventions of ``model/EventChatModel.py:72-76,128-161``).
+    Round-trips with ``eventchat_params_from_hf``."""
+    sd: StateDict = {}
+    sd.update(clip_params_to_hf(
+        params["clip"], cfg.vision,
+        prefix="model.visual_tower.visual_tower.vision_model.",
+    ))
+    sd.update(projector_params_to_hf(params["projector"]))
+    sd.update(llama_params_to_hf(params["llama"], cfg.llama, prefix="model."))
+    return sd
+
+
+def save_sharded_safetensors(sd: StateDict, out_dir: str, num_shards: int = 2) -> None:
+    """Write an HF-style sharded safetensors checkpoint directory
+    (``model-0000i-of-0000N.safetensors`` + ``model.safetensors.index.json``)."""
+    import json
+
+    from safetensors.numpy import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    keys = sorted(sd)
+    per = (len(keys) + num_shards - 1) // num_shards
+    index = {"metadata": {"total_size": int(sum(v.nbytes for v in sd.values()))},
+             "weight_map": {}}
+    for s in range(num_shards):
+        shard_keys = keys[s * per:(s + 1) * per]
+        if not shard_keys:
+            continue
+        name = f"model-{s + 1:05d}-of-{num_shards:05d}.safetensors"
+        save_file({k: np.ascontiguousarray(sd[k]) for k in shard_keys},
+                  os.path.join(out_dir, name))
+        for k in shard_keys:
+            index["weight_map"][k] = name
+    with open(os.path.join(out_dir, "model.safetensors.index.json"), "w") as f:
+        json.dump(index, f, indent=2)
+
+
+# ---------------------------------------------------------------------------
 # File loaders (torch/safetensors touched only here)
 
 

@@ -203,6 +203,96 @@ def _decode_loop_jit(
     return tokens[:, :max_new_tokens], step
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "num_beams", "max_new_tokens", "eos_token_id"),
+    # No cache donation: the first op repeats the cache to num_beams x its
+    # size, so the donated buffers could never be reused anyway (XLA would
+    # just warn on every call).
+)
+def _beam_loop_jit(
+    params,
+    cfg: EventChatConfig,
+    first_logits,
+    cache,
+    num_beams: int,
+    max_new_tokens: int,
+    eos_token_id: int,
+):
+    """On-device deterministic beam search (length-normalized, HF
+    ``length_penalty=1.0`` semantics): cumulative log-prob divided by the
+    generated length at selection time.
+
+    The reference exposes ``num_beams`` through HF generate
+    (``inference.py:22``, default 1). Beams live as an expanded batch
+    (B*num_beams rows) over the same decode_step; each iteration re-gathers
+    the KV cache rows by parent-beam index.
+
+    Returns (tokens [B, max_new_tokens] of the best beam, lengths [B]).
+    """
+    b, v = first_logits.shape
+    k = num_beams
+    neg = jnp.float32(-1e30)
+
+    logp0 = jax.nn.log_softmax(first_logits.astype(jnp.float32), axis=-1)
+    scores, tok0 = lax.top_k(logp0, k)                       # (B, k)
+    cache = {
+        "k": jnp.repeat(cache["k"], k, axis=1),
+        "v": jnp.repeat(cache["v"], k, axis=1),
+        "length": jnp.repeat(cache["length"], k, axis=0),
+    }
+    tokens0 = jnp.zeros((b, k, max_new_tokens), jnp.int32).at[:, :, 0].set(tok0)
+    done0 = tok0 == eos_token_id
+    lengths0 = jnp.ones((b, k), jnp.int32)
+    rows = jnp.arange(b)[:, None]
+
+    # Done beams may only extend with EOS at zero extra log-prob, freezing
+    # their score while open beams keep accumulating.
+    eos_only = jnp.full((v,), neg).at[eos_token_id].set(0.0)
+
+    def cond(state):
+        step, _, _, done, _, _ = state
+        return (step < max_new_tokens) & ~done.all()
+
+    def body(state):
+        step, tokens, scores, done, lengths, cache = state
+        last = jnp.take_along_axis(
+            tokens, jnp.full((b, k, 1), step - 1, jnp.int32), axis=2
+        )[:, :, 0]
+        emb = llama_mod.embed_tokens(params["llama"], last.reshape(b * k)[:, None])
+        logits, cache = llama_mod.decode_step(params["llama"], cfg.llama, emb, cache)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1).reshape(b, k, v)
+        logp = jnp.where(done[:, :, None], eos_only[None, None, :], logp)
+
+        cand = (scores[:, :, None] + logp).reshape(b, k * v)
+        new_scores, idx = lax.top_k(cand, k)                  # (B, k)
+        parent = idx // v
+        tok = idx % v
+
+        tokens = tokens[rows, parent].at[:, :, step].set(tok)
+        par_done = done[rows, parent]
+        lengths = jnp.where(par_done, lengths[rows, parent],
+                            lengths[rows, parent] + 1)
+        done = par_done | (tok == eos_token_id)
+
+        flat_parent = (rows * k + parent).reshape(-1)
+        cache = {
+            "k": cache["k"][:, flat_parent],
+            "v": cache["v"][:, flat_parent],
+            "length": cache["length"][flat_parent],
+        }
+        return step + 1, tokens, new_scores, done, lengths, cache
+
+    _, tokens, scores, done, lengths, _ = lax.while_loop(
+        cond, body,
+        (jnp.int32(1), tokens0, scores, done0, lengths0, cache),
+    )
+    norm = scores / jnp.maximum(lengths, 1).astype(jnp.float32)
+    best = jnp.argmax(norm, axis=1)                           # (B,)
+    row = jnp.arange(b)
+    return tokens[row, best], lengths[row, best]
+
+
 def generate(
     params: Params,
     cfg: EventChatConfig,
@@ -215,12 +305,15 @@ def generate(
     seed: int = 0,
     bucket: int = 128,
     max_context: Optional[int] = None,
+    num_beams: int = 1,
 ) -> List[List[int]]:
     """Autoregressive generation over a batch of event-QA prompts.
 
     Flag parity with the reference run (``inference.py:52-63``): sampling is
     enabled iff temperature > 0, nucleus top_p, greedy otherwise; decode
-    stops per-row at EOS or after ``max_new_tokens``.
+    stops per-row at EOS or after ``max_new_tokens``. ``num_beams > 1``
+    switches to deterministic length-normalized beam search (temperature /
+    top_p are ignored, as with HF ``do_sample=False`` beam decoding).
 
     ``input_ids_batch``: token ids containing -200 sentinels.
     ``pixel_values_batch``: (B, T_frames, C, H, W).
@@ -252,6 +345,20 @@ def generate(
     # EOS sentinel: a real id stops rows early; None decodes the full budget
     # (an out-of-vocab sentinel that never matches a sampled token).
     eos = eos_token_id if eos_token_id is not None else -1
+    if num_beams > 1:
+        tokens, lengths = _beam_loop_jit(
+            params, cfg, last_logits, cache, int(num_beams),
+            max_new_tokens, int(eos),
+        )
+        out_tokens = np.asarray(jax.device_get(tokens))
+        out_lengths = np.asarray(jax.device_get(lengths))
+        results = []
+        for i in range(b):
+            ids = [int(t) for t in out_tokens[i, : out_lengths[i]]]
+            if ids and eos_token_id is not None and ids[-1] == eos_token_id:
+                ids = ids[:-1]
+            results.append(ids)
+        return results
     tokens, num_steps = _decode_loop_jit(
         params, cfg, last_logits, cache, key,
         max_new_tokens, float(temperature), float(top_p), int(eos),

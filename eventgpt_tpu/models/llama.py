@@ -134,11 +134,13 @@ def _attn_block(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
                 k_full: jnp.ndarray, v_full: jnp.ndarray,
                 mask: Optional[jnp.ndarray] = None,
                 valid: Optional[jnp.ndarray] = None,
-                use_flash: bool = False) -> jnp.ndarray:
+                use_flash: bool = False,
+                ring_fn=None) -> jnp.ndarray:
     """Shared attention plumbing (q proj + RoPE + GQA repeat + o proj) with a
-    score-computation switch: dense additive ``mask`` (B,1,Q,S) or the Pallas
-    flash kernel with a (B,S) ``valid`` padding mask (causal implied).
-    x: (B,Q,D); k/v_full: (B,S,KV,hd)."""
+    score-computation switch: dense additive ``mask`` (B,1,Q,S), the Pallas
+    flash kernel with a (B,S) ``valid`` padding mask (causal implied), or a
+    ring-attention shard_map ``ring_fn`` for sequence parallelism over the
+    ``context`` mesh axis. x: (B,Q,D); k/v_full: (B,S,KV,hd)."""
     b, q_len, d = x.shape
     h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.resolved_head_dim()
 
@@ -147,7 +149,9 @@ def _attn_block(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
     k = _repeat_kv(k_full, h // kvh)
     v = _repeat_kv(v_full, h // kvh)
 
-    if use_flash:
+    if ring_fn is not None:
+        ctx = ring_fn(q, k, v, valid, valid).reshape(b, q_len, h * hd)
+    elif use_flash:
         from eventgpt_tpu.ops.flash_attention import flash_attention
 
         ctx = flash_attention(q, k, v, valid=valid, causal=True)
@@ -182,6 +186,7 @@ def prefill(
     attention_mask: jnp.ndarray,
     cache: KVCache,
     last_only: bool = False,
+    mesh=None,
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Run the full prompt; returns (logits, filled cache).
 
@@ -193,15 +198,26 @@ def prefill(
     -> logits (B, V) at each row's final real token — the only position
     ``generate`` consumes; skipping the other T-1 lm_head columns saves
     T x vocab f32 per row (0.66 GB at B=8, S=640).
+
+    ``attn_impl == "ring"`` with a ``mesh`` whose ``context`` axis is > 1
+    runs sequence-parallel ring attention (``parallel/ring.py``): the
+    sequence axis shards over ``context`` and KV blocks rotate via
+    ppermute. T must divide the context axis size. Falls back to dense on a
+    context-1 mesh.
     """
     b, t, d = inputs_embeds.shape
     positions = jnp.cumsum(attention_mask.astype(jnp.int32), axis=1) - 1
     positions = jnp.maximum(positions, 0)
     cos, sin = rope_tables(cfg, positions)
 
+    ring_fn = None
+    if cfg.attn_impl == "ring" and mesh is not None and mesh.shape["context"] > 1:
+        from eventgpt_tpu.parallel.ring import ring_attention_shard_map
+
+        ring_fn = ring_attention_shard_map(mesh, causal=True)
     use_flash = cfg.attn_impl == "flash"
-    if use_flash:
-        mask = None  # the kernel applies causal + padding masks inline
+    if use_flash or ring_fn is not None:
+        mask = None  # causal + padding masks applied inline
     else:
         causal = jnp.tril(jnp.ones((t, t), bool))
         visible = causal[None, None] & attention_mask[:, None, None, :]
@@ -218,7 +234,7 @@ def prefill(
         v = _mm(y, layer["attn"]["v"]).reshape(b, t, cfg.num_kv_heads, -1)
         h_mid = h_in + _attn_block(cfg, y, layer, cos, sin, k, v,
                                    mask=mask, valid=attention_mask,
-                                   use_flash=use_flash)
+                                   use_flash=use_flash, ring_fn=ring_fn)
         y2 = rms_norm(h_mid, layer["post_norm"], cfg.rms_norm_eps)
         h_out = h_mid + _mlp_block(y2, layer)
         return h_out, (k, v)
@@ -293,11 +309,14 @@ def forward(
     cfg: LlamaConfig,
     inputs_embeds: jnp.ndarray,
     attention_mask: Optional[jnp.ndarray] = None,
+    mesh=None,
 ) -> jnp.ndarray:
-    """Cache-free full forward -> logits (B, T, V). Training / eval path."""
+    """Cache-free full forward -> logits (B, T, V). Training / eval path.
+    The cache written by prefill is unused here and DCE'd by XLA."""
     b, t, _ = inputs_embeds.shape
     if attention_mask is None:
         attention_mask = jnp.ones((b, t), bool)
     cache = init_kv_cache(cfg, b, t, dtype=inputs_embeds.dtype)
-    logits, _ = prefill(params, cfg, inputs_embeds, attention_mask, cache)
+    logits, _ = prefill(params, cfg, inputs_embeds, attention_mask, cache,
+                        mesh=mesh)
     return logits

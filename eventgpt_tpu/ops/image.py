@@ -57,6 +57,27 @@ def _center_crop(arr: np.ndarray, crop: int) -> np.ndarray:
     return out
 
 
+def expand2square(img: np.ndarray, background: Iterable[float] = CLIP_MEAN) -> np.ndarray:
+    """Pad an (H, W, C) uint8 image to square, centered, with the CLIP
+    ``image_mean`` background.
+
+    Parity with LLaVA's ``expand2square`` used by ``EventChatDataset.
+    __getitem__`` for ``image_aspect_ratio='square'`` (training pyc,
+    SURVEY.md §2.2): background channels are ``int(mean * 255)`` (floor, as
+    LLaVA computes it) and the image is pasted at ``(side - dim) // 2``.
+    """
+    h, w = img.shape[:2]
+    if h == w:
+        return img
+    side = max(h, w)
+    bg = np.array([int(c * 255) for c in background], dtype=img.dtype)
+    out = np.full((side, side, img.shape[2]), bg, dtype=img.dtype)
+    top = (side - h) // 2
+    left = (side - w) // 2
+    out[top:top + h, left:left + w] = img
+    return out
+
+
 def clip_preprocess(frame: np.ndarray, image_size: int = 336) -> np.ndarray:
     """uint8 RGB (H, W, 3) -> normalized float32 CHW (3, S, S).
 

@@ -66,14 +66,31 @@ def rasterize_events(
     event landing there decides the color, identical to the sequential
     overwrite loop at ``common/common.py:68-73``.
     """
+    inferred_dims = height is None and width is None
     if height is None:
         height = int(y.max()) + 1
     if width is None:
         width = int(x.max()) + 1
 
+    # Drop out-of-frame events identically on every path (ADVICE r1: the
+    # native kernel bounds-checks and drops, while a raw numpy scatter
+    # would raise IndexError — behavior must not depend on which is built).
+    # Skipped on the hot path: unsigned coords with dims inferred from the
+    # maxima are in-bounds by construction.
+    unsigned = (np.issubdtype(np.asarray(x).dtype, np.unsignedinteger)
+                and np.issubdtype(np.asarray(y).dtype, np.unsignedinteger))
+    if not (inferred_dims and unsigned):
+        xi = np.asarray(x).astype(np.int64)
+        yi = np.asarray(y).astype(np.int64)
+        inb = (xi >= 0) & (xi < width) & (yi >= 0) & (yi < height)
+        if not inb.all():
+            x, y, p = np.asarray(x)[inb], np.asarray(y)[inb], np.asarray(p)[inb]
+
     from eventgpt_tpu import native
 
-    if native.available():
+    # The C ABI takes uint16 coordinates; frames beyond that range (never
+    # the case for event cameras) fall back to numpy rather than wrap.
+    if native.available() and height <= 65536 and width <= 65536:
         return native.rasterize_events_native(x, y, p, height, width)
 
     lin = y.astype(np.int64) * width + x.astype(np.int64)

@@ -75,12 +75,16 @@ def _ring_attention_local(
         return o_new, m_new, l_new, k_nxt, v_nxt, kvv_nxt
 
     # Fresh zeros are "unvarying" under shard_map's manual-axes typing while
-    # the loop outputs vary per device; pvary marks them explicitly.
+    # the loop outputs vary per device; pcast marks them explicitly
+    # (pvary's replacement — it was deprecated in jax 0.9).
     from eventgpt_tpu.parallel.mesh import AXES
 
-    o0 = lax.pvary(jnp.zeros((b, sq, h, hd), jnp.float32), AXES)
-    m0 = lax.pvary(jnp.full((b, h, sq), neg, jnp.float32), AXES)
-    l0 = lax.pvary(jnp.zeros((b, h, sq), jnp.float32), AXES)
+    def _vary(x):
+        return lax.pcast(x, AXES, to="varying")
+
+    o0 = _vary(jnp.zeros((b, sq, h, hd), jnp.float32))
+    m0 = _vary(jnp.full((b, h, sq), neg, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, sq), jnp.float32))
     o, m, l, _, _, _ = lax.fori_loop(
         0, axis_size, step, (o0, m0, l0, k, v, kv_valid)
     )
@@ -111,20 +115,27 @@ def ring_self_attention(
     return _ring_jitted(mesh, causal, axis_name)(q, k, v, valid, valid)
 
 
+def ring_attention_shard_map(mesh: Mesh, causal: bool = True,
+                             axis_name: str = "context"):
+    """Un-jitted shard_map over the ring body: ``f(q, k, v, q_valid,
+    kv_valid) -> out``. This is the form model code calls *inside* its own
+    jit (``models/llama.py`` when ``attn_impl == 'ring'``); shard_map
+    composes with the surrounding GSPMD partitioning."""
+    qkv_spec = P(("data", "fsdp"), "context", "model", None)
+    valid_spec = P(("data", "fsdp"), "context")
+    return jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, valid_spec, valid_spec),
+        out_specs=qkv_spec,
+    )
+
+
 @functools.lru_cache(maxsize=32)
 def _ring_jitted(mesh: Mesh, causal: bool, axis_name: str):
     """One jitted shard_map per (mesh, causal, axis) — rebuilding it per call
     would retrace and recompile on every invocation."""
-    qkv_spec = P(("data", "fsdp"), "context", "model", None)
-    valid_spec = P(("data", "fsdp"), "context")
-    return jax.jit(
-        jax.shard_map(
-            functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal),
-            mesh=mesh,
-            in_specs=(qkv_spec, qkv_spec, qkv_spec, valid_spec, valid_spec),
-            out_specs=qkv_spec,
-        )
-    )
+    return jax.jit(ring_attention_shard_map(mesh, causal, axis_name))
 
 
 def dense_reference_attention(q, k, v, valid=None, causal=True):

@@ -72,3 +72,6 @@ class TrainingArguments:
     mesh_fsdp: int = -1
     mesh_model: int = 1
     mesh_context: int = 1
+    # Attention kernel override: "" keeps the model config's choice;
+    # mesh_context > 1 requires "ring" (sequence parallelism).
+    attn_impl: str = ""

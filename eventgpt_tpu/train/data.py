@@ -177,6 +177,7 @@ class EventChatDataset:
         cfg: EventChatConfig,
         event_folder: str = "",
         conv_version: str = "v1",
+        image_aspect_ratio: str = "square",
     ):
         with open(data_path) as f:
             self.entries = json.load(f)
@@ -184,6 +185,7 @@ class EventChatDataset:
         self.cfg = cfg
         self.event_folder = event_folder
         self.preprocess = PREPROCESSORS[conv_version]
+        self.image_aspect_ratio = image_aspect_ratio
 
     def __len__(self) -> int:
         return len(self.entries)
@@ -212,9 +214,15 @@ class EventChatDataset:
         if "image" in entry:
             from PIL import Image
 
+            from eventgpt_tpu.ops.image import expand2square
+
             img = np.asarray(
                 Image.open(os.path.join(self.event_folder, entry["image"])).convert("RGB")
             )
+            if self.image_aspect_ratio == "square":
+                # Pad to square on the image_mean background before CLIP
+                # preprocessing (pyc EventChatDataset / LLaVA semantics).
+                img = expand2square(img)
             # A still image is replicated across the temporal axis so the
             # event pipeline (5-frame contract) applies unchanged.
             frames = [img] * self.cfg.num_event_frames

@@ -67,9 +67,11 @@ def lm_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.
     return loss, n_valid
 
 
-def _forward_loss(params: Params, cfg: EventChatConfig, batch: Batch) -> jnp.ndarray:
+def _forward_loss(params: Params, cfg: EventChatConfig, batch: Batch,
+                  mesh=None) -> jnp.ndarray:
     embeds = multimodal_embeds(params, cfg, batch)
-    logits = llama_mod.forward(params["llama"], cfg.llama, embeds, batch["attn_mask"])
+    logits = llama_mod.forward(params["llama"], cfg.llama, embeds,
+                               batch["attn_mask"], mesh=mesh)
     loss, _ = lm_loss(logits, batch["labels"])
     return loss
 
@@ -105,12 +107,16 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     combine: Callable[[Params, Params], Params] = stage1_combine,
     donate: bool = True,
+    mesh=None,
 ):
     """Build the jitted step: (state, batch) -> (state, metrics).
 
     Gradients flow only into ``state.trainable`` — the frozen tree is a
     closure-free constant argument, which is the whole freeze mechanism
     (no requires_grad bookkeeping as in the reference).
+
+    ``mesh`` enables sequence-parallel ring attention when its ``context``
+    axis is > 1 and ``cfg.llama.attn_impl == "ring"``.
     """
 
     @functools.partial(
@@ -121,7 +127,7 @@ def make_train_step(
     def step(state: TrainState, batch: Batch):
         def loss_fn(trainable):
             params = combine(trainable, state.frozen)
-            return _forward_loss(params, cfg, batch)
+            return _forward_loss(params, cfg, batch, mesh)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.trainable)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.trainable)
@@ -133,12 +139,15 @@ def make_train_step(
     return step
 
 
-def make_eval_step(cfg: EventChatConfig, combine: Callable[[Params, Params], Params] = stage1_combine):
+def make_eval_step(cfg: EventChatConfig,
+                   combine: Callable[[Params, Params], Params] = stage1_combine,
+                   mesh=None):
     @jax.jit
     def step(state: TrainState, batch: Batch):
         params = combine(state.trainable, state.frozen)
         embeds = multimodal_embeds(params, cfg, batch)
-        logits = llama_mod.forward(params["llama"], cfg.llama, embeds, batch["attn_mask"])
+        logits = llama_mod.forward(params["llama"], cfg.llama, embeds,
+                                   batch["attn_mask"], mesh=mesh)
         loss, n = lm_loss(logits, batch["labels"])
         return {"loss": loss, "n_tokens": n}
 
@@ -190,13 +199,25 @@ def batch_to_device(batch: Dict[str, Any], mesh=None) -> Batch:
     dp = mesh.shape["data"] * mesh.shape["fsdp"]
     b = next(iter(batch.values())).shape[0]
     if b % dp:
-        # Batch smaller than / not divisible by the DP extent (tiny smoke
-        # runs): replicate rather than fail. Production batches divide dp.
-        spec_fn = lambda ndim: PartitionSpec()
+        # Silently replicating here would quietly lose all data parallelism
+        # on a misconfigured pod run — fail loudly instead (VERDICT r1 #6).
+        raise ValueError(
+            f"batch size {b} does not divide the data-parallel extent "
+            f"dp={dp} (mesh data={mesh.shape['data']} x "
+            f"fsdp={mesh.shape['fsdp']}); pick a batch that is a multiple "
+            f"of dp or shrink the mesh"
+        )
     else:
-        spec_fn = batch_spec
+        # 2D (B, T) arrays additionally shard the sequence axis over the
+        # context axis (ring-attention sequence parallelism); a context-1
+        # axis (or a non-dividing T) makes that a no-op.
+        ctx = mesh.shape["context"]
+        spec_fn = lambda v: batch_spec(
+            np_ndim(v),
+            seq_axis=1 if np_ndim(v) == 2 and v.shape[1] % ctx == 0 else None,
+        )
     return {
-        k: jax.device_put(jnp.asarray(v), NamedSharding(mesh, spec_fn(np_ndim(v))))
+        k: jax.device_put(jnp.asarray(v), NamedSharding(mesh, spec_fn(v)))
         for k, v in batch.items()
     }
 

@@ -70,18 +70,49 @@ class Trainer:
                     jax.device_count(),
                     model=train_args.mesh_model, context=train_args.mesh_context,
                 )
-            mesh = make_mesh(mcfg)
+            # An explicit mesh smaller than the host's device count is valid
+            # (smoke runs on a virtual mesh); take the first N devices.
+            mesh = make_mesh(mcfg, devices=jax.devices()[:mcfg.num_devices])
         self.mesh = mesh
+
+        if train_args.attn_impl:
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                cfg, llama=dataclasses.replace(cfg.llama, attn_impl=train_args.attn_impl)
+            )
+        ctx = mesh.shape["context"]
+        if ctx > 1 and cfg.llama.attn_impl != "ring":
+            raise ValueError(
+                "mesh_context > 1 requires attn_impl='ring' (sequence-parallel "
+                "ring attention); dense/flash attention cannot consume a "
+                "context-sharded sequence"
+            )
+        if ctx > 1 and 64 % ctx:
+            # Collated batches pad T to a multiple of the 64-token bucket
+            # (train/data.py:collate_fixed_layout), so a context size that
+            # divides 64 always divides T; anything else would die with an
+            # opaque shard_map divisibility error on the first step.
+            raise ValueError(
+                f"mesh_context={ctx} must divide the 64-token sequence bucket "
+                f"(use 2, 4, 8, ...)"
+            )
+        self.cfg = cfg
 
         self.dataset = EventChatDataset(
             data_args.data_path, tokenizer, cfg,
             event_folder=data_args.event_folder,
             conv_version=data_args.conv_version,
+            image_aspect_ratio=data_args.image_aspect_ratio,
         )
 
         # --- stage split + shardings -----------------------------------
+        # bf16 applies to the FROZEN tree and the forward compute only;
+        # trainable master weights and AdamW moments stay f32 (ADVICE r1:
+        # bf16 Adam moments degrade stage-1 projector training), with a
+        # cast to the compute dtype inside the combine.
         dtype = jnp.bfloat16 if train_args.bf16 else jnp.float32
-        params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype), params)
+        self.compute_dtype = dtype
         proj_specs = projector_param_specs(
             cfg.projector.use_feature_adaptor, cfg.projector.mlp_depth
         )
@@ -134,13 +165,34 @@ class Trainer:
             trainable_specs = {"projector": proj_specs}
             self.combine = steps_mod.stage1_combine
 
+        # Master trainables f32; frozen tree in the compute dtype; the
+        # forward sees everything in compute dtype via the combine wrapper.
+        trainable = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, jnp.float32), trainable
+        )
+        frozen = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype), frozen)
+        base_combine = self.combine
+
+        def cast_combine(tr, fz, _base=base_combine, _dt=dtype):
+            tr = jax.tree_util.tree_map(lambda x: x.astype(_dt), tr)
+            return _base(tr, fz)
+
+        self.combine = cast_combine
+
         trainable = shard_params(trainable, trainable_specs, mesh)
         frozen = shard_params(frozen, frozen_specs, mesh)
 
         # --- optimizer ---------------------------------------------------
-        steps_per_epoch = max(
-            len(self.dataset) // (train_args.per_device_train_batch_size), 1
-        )
+        # HF semantics throughout: per_device_train_batch_size is per chip
+        # (global batch = per_device x dp), and max_steps / warmup /
+        # save_steps / the schedule all count OPTIMIZER updates — one per
+        # gradient_accumulation_steps micro-batches (optax.MultiSteps ticks
+        # the inner schedule at that same rate).
+        dp = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        self.global_batch_size = train_args.per_device_train_batch_size * dp
+        accum = max(train_args.gradient_accumulation_steps, 1)
+        micro_per_epoch = len(self.dataset) // self.global_batch_size
+        steps_per_epoch = max(micro_per_epoch // accum, 1)
         total = (train_args.max_steps if train_args.max_steps > 0
                  else steps_per_epoch * train_args.num_train_epochs)
         warmup = (train_args.warmup_steps if train_args.warmup_steps > 0
@@ -159,7 +211,9 @@ class Trainer:
         self.total_steps = total
 
         self.state = steps_mod.init_train_state(trainable, frozen, self.optimizer)
-        self.train_step = steps_mod.make_train_step(cfg, self.optimizer, self.combine)
+        self.train_step = steps_mod.make_train_step(
+            cfg, self.optimizer, self.combine, mesh=mesh
+        )
         self.metrics_path = os.path.join(train_args.output_dir, "metrics.jsonl")
 
     # ------------------------------------------------------------------
@@ -211,17 +265,23 @@ class Trainer:
     # ------------------------------------------------------------------
     def train(self) -> Dict[str, float]:
         targs = self.targs
-        step = int(jax.device_get(self.state.step))
+        accum = max(targs.gradient_accumulation_steps, 1)
+        # state.step counts micro-batches (it ticks inside the jitted step);
+        # user-facing step counts optimizer updates (HF semantics).
+        micro = int(jax.device_get(self.state.step))
+        step = micro // accum
         done = False
         last_metrics: Dict[str, float] = {}
         t_start = time.perf_counter()
         tokens_seen = 0
 
-        if len(self.dataset) < targs.per_device_train_batch_size:
+        if len(self.dataset) < self.global_batch_size:
             raise ValueError(
-                f"dataset has {len(self.dataset)} entries but batch size is "
-                f"{targs.per_device_train_batch_size}; every epoch would yield "
-                f"zero batches (drop_last)"
+                f"dataset has {len(self.dataset)} entries but the global "
+                f"batch is {self.global_batch_size} "
+                f"({targs.per_device_train_batch_size}/device x dp="
+                f"{self.global_batch_size // targs.per_device_train_batch_size}); "
+                f"every epoch would yield zero batches (drop_last)"
             )
         # With max_steps > 0, cycle epochs until the step budget is spent
         # (HF Trainer semantics); otherwise run num_train_epochs exactly.
@@ -230,30 +290,40 @@ class Trainer:
             if done:
                 break
             it = batch_iterator(
-                self.dataset, targs.per_device_train_batch_size, self.cfg,
+                self.dataset, self.global_batch_size, self.cfg,
                 shuffle=True, seed=targs.seed + epoch,
                 group_by_modality_length=targs.group_by_modality_length,
                 max_len=targs.model_max_length,
             )
+            window: list = []  # (loss, grad_norm) device scalars, one per micro
+            t_window = time.perf_counter()
             for host_batch in it:
                 batch = steps_mod.batch_to_device(host_batch, self.mesh)
-                t0 = time.perf_counter()
                 self.state, metrics = self.train_step(self.state, batch)
-                step += 1
+                micro += 1
                 tokens_seen += int(host_batch["attn_mask"].sum())
+                window.append((metrics["loss"], metrics["grad_norm"]))
+                if micro % accum:
+                    continue  # gradients still accumulating
+                step += 1
 
                 if step % targs.logging_steps == 0 or step == 1:
-                    # Host readback only on logging steps — an unconditional
+                    # Mean over the accumulation window (HF reports per
+                    # optimizer step, not last-micro-batch noise). Host
+                    # readback only on logging steps — an unconditional
                     # device_get would fence async dispatch every step.
-                    loss = float(jax.device_get(metrics["loss"]))
-                    dt = time.perf_counter() - t0
+                    loss = float(jax.device_get(sum(w[0] for w in window))) / len(window)
+                    gnorm = float(jax.device_get(sum(w[1] for w in window))) / len(window)
+                    dt = time.perf_counter() - t_window
                     last_metrics = {
                         "step": step, "epoch": epoch, "loss": loss,
-                        "grad_norm": float(jax.device_get(metrics["grad_norm"])),
+                        "grad_norm": gnorm,
                         "step_time_s": round(dt, 4),
                         "tokens_per_s": round(tokens_seen / (time.perf_counter() - t_start), 1),
                     }
                     self._log(last_metrics)
+                window.clear()
+                t_window = time.perf_counter()
                 if targs.save_steps > 0 and step % targs.save_steps == 0:
                     self.save(f"step{step}")
                 if 0 < targs.max_steps <= step:

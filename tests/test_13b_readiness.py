@@ -1,0 +1,96 @@
+"""13B / FSDP readiness (VERDICT r1 #8): the eventgpt_13b config must shard
+and compile without materializing weights — eval_shape the param tree, apply
+the sharding specs on the 8-device mesh, and AOT-compile one stage-2 train
+step from abstract inputs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgpt_tpu.config import EventChatConfig, MeshConfig
+from eventgpt_tpu.models import eventchat
+from eventgpt_tpu.parallel import make_mesh
+from eventgpt_tpu.parallel.sharding import (
+    clip_param_specs,
+    llama_param_specs,
+    projector_param_specs,
+    tree_shardings,
+)
+from eventgpt_tpu.train import steps as steps_mod
+from eventgpt_tpu.train.data import synthetic_multimodal_batch
+from eventgpt_tpu.train.lora import LoraConfig, lora_param_specs
+from eventgpt_tpu.train.optim import linear_warmup_cosine, make_optimizer
+
+
+def _abstract(tree, shardings=None):
+    if shardings is None:
+        return jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), tree
+        )
+    return jax.tree_util.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        tree, shardings,
+    )
+
+
+def test_13b_shards_and_compiles_one_train_step():
+    cfg = EventChatConfig.eventgpt_13b()
+    assert cfg.llama.hidden_size == 5120 and cfg.llama.num_layers == 40
+    mesh = make_mesh(MeshConfig(data=1, fsdp=4, context=1, model=2))
+
+    shapes = jax.eval_shape(
+        lambda k: eventchat.init_eventchat_params(cfg, k, jnp.bfloat16),
+        jax.random.PRNGKey(0),
+    )
+    lcfg = LoraConfig(r=8)
+    tr_shapes, fz_shapes = jax.eval_shape(
+        lambda p: steps_mod.split_stage2(p, cfg, lcfg, jax.random.PRNGKey(1)),
+        shapes,
+    )
+
+    proj_specs = projector_param_specs(
+        cfg.projector.use_feature_adaptor, cfg.projector.mlp_depth
+    )
+    tr_sh = tree_shardings(
+        {"projector": proj_specs, "lora": lora_param_specs(lcfg.targets)}, mesh
+    )
+    fz_sh = tree_shardings(
+        {"clip": clip_param_specs(), "llama": llama_param_specs()}, mesh
+    )
+    # Sharding application: every 13B leaf must accept its spec (divisibility
+    # of 5120/13824 dims over fsdp=4 x model=2 included).
+    tr_abs = _abstract(tr_shapes, tr_sh)
+    fz_abs = _abstract(fz_shapes, fz_sh)
+
+    opt = make_optimizer(linear_warmup_cosine(1e-4, 100, 10))
+    state_abs = jax.eval_shape(
+        lambda t, f: steps_mod.init_train_state(t, f, opt), tr_abs, fz_abs
+    )
+    # Re-attach shardings lost through eval_shape for the state pytree.
+    state_abs = steps_mod.TrainState(
+        trainable=tr_abs,
+        frozen=fz_abs,
+        opt_state=_abstract(state_abs.opt_state),
+        step=jax.ShapeDtypeStruct((), jnp.int32),
+    )
+
+    host = synthetic_multimodal_batch(cfg, 4, 704)
+    batch_abs = {
+        k: jax.ShapeDtypeStruct(
+            v.shape, jnp.bfloat16 if k == "pixel_values" else v.dtype
+        )
+        for k, v in host.items()
+    }
+
+    step_fn = steps_mod.make_train_step(
+        cfg, opt, steps_mod.make_stage2_combine(lcfg), donate=False, mesh=mesh
+    )
+    lowered = step_fn.lower(state_abs, batch_abs)
+    compiled = lowered.compile()
+    # The compiled step's output structure matches the state structure.
+    out_state, metrics = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure((state_abs, {"loss": 0, "grad_norm": 0})),
+        jax.tree_util.tree_leaves(compiled.output_shardings),
+    )
+    assert "loss" in metrics

@@ -47,3 +47,65 @@ def test_jax_normalize_matches_numpy(rng):
     # Against the host path minus resize/crop (identity at target size).
     expected = np.stack([clip_preprocess(f, 336) for f in frames])
     np.testing.assert_allclose(out, expected, atol=1e-5)
+
+
+def test_expand2square_matches_pil_reference():
+    """Golden parity with LLaVA's PIL expand2square (the pyc's image branch,
+    SURVEY.md §2.2): int(mean*255) background, centered paste."""
+    from PIL import Image
+
+    from eventgpt_tpu.ops.image import CLIP_MEAN, expand2square
+
+    def pil_reference(pil_img, background_color):
+        width, height = pil_img.size
+        if width == height:
+            return pil_img
+        if width > height:
+            result = Image.new(pil_img.mode, (width, width), background_color)
+            result.paste(pil_img, (0, (width - height) // 2))
+            return result
+        result = Image.new(pil_img.mode, (height, height), background_color)
+        result.paste(pil_img, ((height - width) // 2, 0))
+        return result
+
+    rng = np.random.default_rng(7)
+    bg = tuple(int(x * 255) for x in CLIP_MEAN)
+    for h, w in [(30, 50), (50, 30), (41, 40), (17, 17)]:
+        img = rng.integers(0, 256, (h, w, 3)).astype(np.uint8)
+        want = np.asarray(pil_reference(Image.fromarray(img), bg))
+        got = expand2square(img)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_dataset_image_entry_expand2square(tmp_path):
+    """Non-square image entries go through expand2square before CLIP; the
+    padded region preprocesses to ~zero (mean-background)."""
+    import json as _json
+
+    from PIL import Image
+
+    from eventgpt_tpu.config import EventChatConfig
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.train.data import EventChatDataset
+
+    cfg = EventChatConfig.tiny()
+    img = np.zeros((10, 40, 3), np.uint8)  # very wide black bar
+    Image.fromarray(img).save(tmp_path / "bar.png")
+    entries = [{"id": 0, "image": "bar.png",
+                "conversations": [
+                    {"from": "human", "value": "<event>\nDescribe."},
+                    {"from": "gpt", "value": "A bar."}]}]
+    (tmp_path / "qa.json").write_text(_json.dumps(entries))
+
+    ds_square = EventChatDataset(str(tmp_path / "qa.json"), load_tokenizer("byte"),
+                                 cfg, event_folder=str(tmp_path))
+    ds_raw = EventChatDataset(str(tmp_path / "qa.json"), load_tokenizer("byte"),
+                              cfg, event_folder=str(tmp_path),
+                              image_aspect_ratio="keep")
+    px_square = ds_square[0].pixel_values
+    px_raw = ds_raw[0].pixel_values
+    assert px_square.shape == px_raw.shape
+    # Square mode: top rows are mean-background -> normalized ~0.
+    assert np.abs(px_square[0, :, :3, :]).mean() < 0.05
+    # Raw mode stretches/crops the black bar -> strongly negative pixels.
+    assert not np.allclose(px_square, px_raw)

@@ -105,3 +105,16 @@ def test_sample1_matches_reference_loop(sample1_events):
     np.testing.assert_array_equal(
         rasterize_events(x[sl], y[sl], p[sl]), reference_raster(x[sl], y[sl], p[sl])
     )
+
+
+def test_out_of_frame_events_dropped_not_raised():
+    """Explicit dims smaller than the coordinate range: OOB events are
+    dropped on every backend (ADVICE r1 native/numpy divergence)."""
+    x = np.array([0, 5, 100], dtype=np.uint16)
+    y = np.array([0, 5, 100], dtype=np.uint16)
+    p = np.array([1, 0, 1], dtype=np.uint8)
+    frame = rasterize_events(x, y, p, height=10, width=10)
+    assert frame.shape == (10, 10, 3)
+    assert (frame[0, 0] == [255, 0, 0]).all()     # polarity 1 -> red
+    assert (frame[5, 5] == [0, 0, 255]).all()     # polarity 0 -> blue
+    assert (frame[9, 9] == [255, 255, 255]).all()  # untouched background

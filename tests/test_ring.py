@@ -48,3 +48,81 @@ def test_ring_noncausal():
     ref = dense_reference_attention(q, k, v, causal=False)
     out = ring_self_attention(q, k, v, mesh, causal=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-4)
+
+
+def test_full_model_forward_ring_matches_dense():
+    """The wired path (llama.forward with attn_impl='ring' on a context-2
+    mesh) matches the unsharded dense forward — VERDICT r1 item 5: ring must
+    be reachable from the model, not just the op."""
+    import dataclasses
+
+    from eventgpt_tpu.config import LlamaConfig
+    from eventgpt_tpu.models import llama as llama_mod
+
+    cfg = LlamaConfig.tiny()
+    params = llama_mod.init_llama_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshConfig(data=1, fsdp=2, context=2, model=2))
+
+    ids = jnp.arange(32)[None].repeat(2, 0)
+    embeds = llama_mod.embed_tokens(params, ids)
+    mask = jnp.asarray(np.arange(32)[None, :] < np.array([[32], [24]])[:, 0:1])
+
+    ref = llama_mod.forward(params, cfg, embeds, mask)
+    rcfg = dataclasses.replace(cfg, attn_impl="ring")
+    out = jax.jit(
+        lambda p, e, m: llama_mod.forward(p, rcfg, e, m, mesh=mesh)
+    )(params, embeds, mask)
+    # Padded positions differ by design (ring zeroes masked queries, dense
+    # leaves don't-care values); only real-token logits are comparable.
+    valid = np.asarray(mask)
+    np.testing.assert_allclose(
+        np.asarray(out)[valid], np.asarray(ref)[valid], atol=2e-4, rtol=2e-4
+    )
+
+
+def test_full_train_step_ring_matches_dense():
+    """Stage-2 train step on a context-2 mesh (ring) reproduces the
+    unsharded step's loss and gradients-in-effect (next-step loss)."""
+    import dataclasses
+
+    from eventgpt_tpu.config import EventChatConfig
+    from eventgpt_tpu.models import eventchat
+    from eventgpt_tpu.train import steps as steps_mod
+    from eventgpt_tpu.train.data import synthetic_multimodal_batch
+    from eventgpt_tpu.train.lora import LoraConfig
+    from eventgpt_tpu.train.optim import linear_warmup_cosine, make_optimizer
+
+    cfg = EventChatConfig.tiny()
+    rcfg = dataclasses.replace(
+        cfg, llama=dataclasses.replace(cfg.llama, attn_impl="ring")
+    )
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    lcfg = LoraConfig(r=4)
+    opt = make_optimizer(linear_warmup_cosine(1e-3, 10, 0))
+    host = synthetic_multimodal_batch(cfg, 4, 64, event_offset=8)
+
+    def one_step(use_mesh):
+        trainable, frozen = steps_mod.split_stage2(
+            params, cfg, lcfg, jax.random.PRNGKey(1)
+        )
+        state = steps_mod.init_train_state(trainable, frozen, opt)
+        if use_mesh:
+            mesh = make_mesh(MeshConfig(data=2, fsdp=1, context=2, model=2))
+            step = steps_mod.make_train_step(
+                rcfg, opt, steps_mod.make_stage2_combine(lcfg),
+                donate=False, mesh=mesh,
+            )
+            batch = steps_mod.batch_to_device(host, mesh)
+        else:
+            step = steps_mod.make_train_step(
+                cfg, opt, steps_mod.make_stage2_combine(lcfg), donate=False
+            )
+            batch = steps_mod.batch_to_device(host)
+        state, m1 = step(state, batch)
+        state, m2 = step(state, batch)
+        return float(m1["loss"]), float(m2["loss"])
+
+    l1_ring, l2_ring = one_step(True)
+    l1_ref, l2_ref = one_step(False)
+    assert abs(l1_ring - l1_ref) < 1e-4
+    assert abs(l2_ring - l2_ref) < 1e-3  # grads applied once: same trajectory

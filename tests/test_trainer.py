@@ -39,10 +39,12 @@ def _make_trainer(toy_data, tmp_path, stage, **kw):
     cfg = EventChatConfig.tiny()
     params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
     tok = load_tokenizer("byte")
+    # dp = data x fsdp = 2 -> global batch = 2/device x 2 = 4 (= dataset).
     targs = TrainingArguments(
         output_dir=str(tmp_path / "out"), stage=stage, max_steps=3,
         per_device_train_batch_size=2, logging_steps=1, save_steps=-1,
-        bf16=False, learning_rate=1e-2, **kw,
+        bf16=False, learning_rate=1e-2,
+        mesh_data=1, mesh_fsdp=2, **kw,
     )
     return Trainer(
         cfg, params, tok,
@@ -82,3 +84,14 @@ def test_stage2_trainer_and_resume(toy_data, tmp_path):
     b = jax.tree_util.tree_leaves(tr2.state.trainable)
     for x, y in zip(a, b):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_load_component_rejects_foreign_keys(tmp_path):
+    """Foreign keys in a component npz fail loudly (ADVICE r1)."""
+    import numpy as np
+
+    path = str(tmp_path / "bad.npz")
+    np.savez(path, **{"model.visual_projector.mlp.0.kernel": np.zeros((2, 2)),
+                      "unrelated.weight": np.zeros(3)})
+    with pytest.raises(ValueError, match="unrelated"):
+        ckpt.load_component(path, strip_prefix="model.visual_projector.")

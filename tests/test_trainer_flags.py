@@ -37,6 +37,8 @@ def _trainer(toy_data, tmp_path, **targ_kw):
     cfg = EventChatConfig.tiny()
     params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
     targ_kw.setdefault("per_device_train_batch_size", 2)
+    targ_kw.setdefault("mesh_data", 1)
+    targ_kw.setdefault("mesh_fsdp", 2)  # dp=2 -> global batch 4 (= dataset)
     targs = TrainingArguments(
         output_dir=str(tmp_path / "out"), max_steps=2,
         logging_steps=1, save_steps=-1,
@@ -83,3 +85,40 @@ def test_batch_larger_than_dataset_rejected(toy_data, tmp_path):
     tr = _trainer(toy_data, tmp_path, stage=1, per_device_train_batch_size=8)
     with pytest.raises(ValueError, match="zero batches"):
         tr.train()
+
+
+def test_per_device_batch_is_per_chip(toy_data, tmp_path):
+    """HF semantics (VERDICT r1 #6): global batch = per_device x dp."""
+    tr = _trainer(toy_data, tmp_path, stage=1,
+                  per_device_train_batch_size=1, mesh_data=2, mesh_fsdp=2)
+    assert tr.global_batch_size == 4
+    # And each step consumes global_batch rows: 4 entries / 4 = 1 batch/epoch.
+    metrics = tr.train()
+    assert metrics["step"] == 2
+
+
+def test_nondivisible_batch_fails_loudly():
+    """batch_to_device must raise, not silently replicate (VERDICT r1 #6)."""
+    from eventgpt_tpu.config import EventChatConfig, MeshConfig
+    from eventgpt_tpu.parallel import make_mesh
+    from eventgpt_tpu.train import steps as steps_mod
+    from eventgpt_tpu.train.data import synthetic_multimodal_batch
+
+    cfg = EventChatConfig.tiny()
+    mesh = make_mesh(MeshConfig(data=2, fsdp=2, model=2))
+    host = synthetic_multimodal_batch(cfg, 3, 64, event_offset=8)
+    with pytest.raises(ValueError, match="does not divide"):
+        steps_mod.batch_to_device(host, mesh)
+
+
+def test_grad_accum_counts_optimizer_steps(toy_data, tmp_path):
+    """max_steps counts optimizer updates; k micro-batches per update
+    (ADVICE r1: the schedule horizon was sized in micro-batches)."""
+    tr = _trainer(toy_data, tmp_path, stage=1,
+                  gradient_accumulation_steps=2,
+                  per_device_train_batch_size=1, mesh_data=1, mesh_fsdp=2)
+    metrics = tr.train()
+    assert metrics["step"] == 2
+    # 2 optimizer steps x 2 micro-batches = 4 jitted step calls recorded
+    # in the (micro-counting) device step counter.
+    assert int(jax.device_get(tr.state.step)) == 4
