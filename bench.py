@@ -149,7 +149,9 @@ def run_decode(args) -> None:
         cache_len = ((prompt_len + args.decode_tokens + 64) // 64) * 64
 
         def prefill_once():
-            cache = llama_mod.init_kv_cache(cfg.llama, batch, cache_len, dtype)
+            cache = llama_mod.init_kv_cache(
+                cfg.llama, batch, cache_len, dtype, quant=args.kv == "int8"
+            )
             last, cache = _prefill_jit(params, cfg, padded, mask, cache, True)
             return last, cache
 
@@ -185,6 +187,7 @@ def run_decode(args) -> None:
 
     extras = {
         "quant": args.quant if preset == "7b" else "bf16",
+        "kv_cache": args.kv,
         "batch": args.batch,
         "decode_tokens": args.decode_tokens,
         "prefill_s": round(t_prefill, 3),
@@ -297,6 +300,8 @@ def main() -> None:
     p.add_argument("--decode_tokens", type=int, default=64)
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--quant", default="int8", choices=["int8", "bf16"])
+    p.add_argument("--kv", default="bf16", choices=["bf16", "int8"],
+                   help="decode KV cache storage")
     p.add_argument("--sweep", action="store_true")
     p.add_argument("--seq", type=int, default=704)
     p.add_argument("--steps", type=int, default=4)

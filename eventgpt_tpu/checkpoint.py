@@ -103,6 +103,31 @@ def save_component(path: str, tree: Params, prefix: str = "") -> None:
     np.savez(path, **flat)
 
 
+def find_latest_checkpoint(output_dir: str) -> Optional[str]:
+    """Most recent checkpoint under ``output_dir``: the highest
+    ``ckpt_step{N}``, else ``ckpt_last``, else None.
+
+    The restart-after-failure recipe (``--resume_from auto``): a crashed or
+    preempted run re-launches with the same command and continues from the
+    last durable state — the TPU-era replacement for the reference stack's
+    (absent) recovery story, SURVEY.md §5 "Failure detection".
+    """
+    import re
+
+    if not os.path.isdir(output_dir):
+        return None
+    best_step, best = -1, None
+    for name in os.listdir(output_dir):
+        m = re.fullmatch(r"ckpt_step(\d+)", name)
+        if m and int(m.group(1)) > best_step:
+            best_step, best = int(m.group(1)), os.path.join(output_dir, name)
+    if best is None:
+        last = os.path.join(output_dir, "ckpt_last")
+        if os.path.isdir(last):
+            return last
+    return best
+
+
 def load_component(path: str, strip_prefix: str = "") -> Params:
     """Load an npz component, rewriting keys by stripping ``strip_prefix`` —
     the semantics of the reference's partial ``torch.load`` +

@@ -66,6 +66,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="prefill attention kernel (default: flash on TPU)")
     p.add_argument("--quant", type=str, default="none", choices=["none", "int8"],
                    help="weight-only quantization of the LM matmuls")
+    p.add_argument("--kv_cache", type=str, default="bf16", choices=["bf16", "int8"],
+                   help="KV cache storage (int8 halves cache memory/bandwidth)")
     p.add_argument("--timing", action="store_true", help="print stage timings to stderr")
     return p
 
@@ -169,6 +171,7 @@ def main(argv=None) -> str:
         seed=args.seed,
         max_context=args.context_len,
         num_beams=args.num_beams,
+        kv_quant=args.kv_cache == "int8",
     )[0]
     t_gen = time.perf_counter() - t0
 

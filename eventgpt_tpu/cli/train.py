@@ -51,7 +51,12 @@ def main(argv=None):
     parser = argparse.ArgumentParser(description="EventGPT-TPU trainer")
     for cls in (ModelArguments, DataArguments, TrainingArguments):
         _add_dataclass_args(parser, cls)
-    parser.add_argument("--resume_from", type=str, default=None)
+    parser.add_argument(
+        "--resume_from", type=str, default=None,
+        help="checkpoint dir, or 'auto' to continue from the most recent "
+             "ckpt_step*/ckpt_last under --output_dir (crash/preemption "
+             "recovery: relaunch the same command with this flag)",
+    )
     args = parser.parse_args(argv)
 
     initialize_distributed()
@@ -74,7 +79,14 @@ def main(argv=None):
         )
 
     trainer = Trainer(cfg, params, tokenizer, margs, dargs, targs)
-    if args.resume_from:
+    if args.resume_from == "auto":
+        from eventgpt_tpu.checkpoint import find_latest_checkpoint
+
+        latest = find_latest_checkpoint(targs.output_dir)
+        if latest:
+            logging.getLogger(__name__).info("auto-resuming from %s", latest)
+            trainer.resume(latest)
+    elif args.resume_from:
         trainer.resume(args.resume_from)
     metrics = trainer.train()
     print(metrics)

@@ -236,9 +236,12 @@ def _beam_loop_jit(
 
     logp0 = jax.nn.log_softmax(first_logits.astype(jnp.float32), axis=-1)
     scores, tok0 = lax.top_k(logp0, k)                       # (B, k)
+    # tree_map keeps this agnostic to the cache payload (bf16 arrays or
+    # int8 {"q","s"} dicts).
+    rep = lambda t, ax: jax.tree_util.tree_map(lambda x: jnp.repeat(x, k, axis=ax), t)
     cache = {
-        "k": jnp.repeat(cache["k"], k, axis=1),
-        "v": jnp.repeat(cache["v"], k, axis=1),
+        "k": rep(cache["k"], 1),
+        "v": rep(cache["v"], 1),
         "length": jnp.repeat(cache["length"], k, axis=0),
     }
     tokens0 = jnp.zeros((b, k, max_new_tokens), jnp.int32).at[:, :, 0].set(tok0)
@@ -276,9 +279,10 @@ def _beam_loop_jit(
         done = par_done | (tok == eos_token_id)
 
         flat_parent = (rows * k + parent).reshape(-1)
+        sel = lambda t: jax.tree_util.tree_map(lambda x: x[:, flat_parent], t)
         cache = {
-            "k": cache["k"][:, flat_parent],
-            "v": cache["v"][:, flat_parent],
+            "k": sel(cache["k"]),
+            "v": sel(cache["v"]),
             "length": cache["length"][flat_parent],
         }
         return step + 1, tokens, new_scores, done, lengths, cache
@@ -306,6 +310,7 @@ def generate(
     bucket: int = 128,
     max_context: Optional[int] = None,
     num_beams: int = 1,
+    kv_quant: bool = False,
 ) -> List[List[int]]:
     """Autoregressive generation over a batch of event-QA prompts.
 
@@ -335,7 +340,9 @@ def generate(
     # Bucket the cache length to stabilize compiled shapes across prompts.
     max_len = t + max_new_tokens
     max_len = ((max_len + bucket - 1) // bucket) * bucket
-    cache = llama_mod.init_kv_cache(cfg.llama, b, max_len, dtype=compute_dtype)
+    cache = llama_mod.init_kv_cache(
+        cfg.llama, b, max_len, dtype=compute_dtype, quant=kv_quant
+    )
 
     last_logits, cache = _prefill_jit(params, cfg, padded, mask, cache, True)
 

@@ -38,6 +38,10 @@ from eventgpt_tpu.train.optim import linear_warmup_cosine, make_optimizer
 log = logging.getLogger("eventgpt_tpu.train")
 
 
+class TrainingDivergedError(RuntimeError):
+    """Loss went non-finite; training state before the divergence is on disk."""
+
+
 class Trainer:
     """Two-stage EventChat trainer.
 
@@ -314,6 +318,15 @@ class Trainer:
                     # device_get would fence async dispatch every step.
                     loss = float(jax.device_get(sum(w[0] for w in window))) / len(window)
                     gnorm = float(jax.device_get(sum(w[1] for w in window))) / len(window)
+                    if not math.isfinite(loss):
+                        # Piggybacks on the logging readback (no extra fence):
+                        # fail loudly with the recovery recipe instead of
+                        # silently corrupting every later step.
+                        raise TrainingDivergedError(
+                            f"non-finite loss {loss} at optimizer step {step}; "
+                            f"restart with --resume_from auto to continue from "
+                            f"the last checkpoint in {targs.output_dir}"
+                        )
                     dt = time.perf_counter() - t_window
                     last_metrics = {
                         "step": step, "epoch": epoch, "loss": loss,

@@ -86,3 +86,41 @@ def test_quantized_decode_matches_quantized_prefill():
     np.testing.assert_allclose(
         np.asarray(step_logits[0]), np.asarray(full[0, -1]), rtol=2e-4, atol=2e-4
     )
+
+
+def test_int8_kv_cache_decode_close_to_bf16():
+    """Prefill + decode with an int8 KV cache tracks the f32-cache results
+    (per-vector symmetric scales keep the error at the int8 noise floor),
+    and greedy generate picks the same tokens."""
+    from eventgpt_tpu.config import EventChatConfig
+    from eventgpt_tpu.models import eventchat
+
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(4))
+    pv = jnp.zeros((1, cfg.num_event_frames, 3, cfg.vision.image_size,
+                    cfg.vision.image_size), jnp.float32)
+    ids = [1, 5, -200, 9, 9, 12]
+    out_ref = eventchat.generate(params, cfg, [ids], pv, max_new_tokens=8,
+                                 temperature=0.0, eos_token_id=2)[0]
+    out_q = eventchat.generate(params, cfg, [ids], pv, max_new_tokens=8,
+                               temperature=0.0, eos_token_id=2, kv_quant=True)[0]
+    assert out_q == out_ref
+
+
+def test_int8_kv_cache_logit_error_bounded():
+    cfg = LlamaConfig.tiny()
+    params = llama_mod.init_llama_params(cfg, jax.random.PRNGKey(5))
+    ids = jnp.arange(12)[None]
+    embeds = llama_mod.embed_tokens(params, ids)
+    mask = jnp.ones((1, 12), bool)
+
+    def run(quant_cache):
+        cache = llama_mod.init_kv_cache(cfg, 1, 16, jnp.float32, quant=quant_cache)
+        logits, cache = llama_mod.prefill(params, cfg, embeds[:, :11],
+                                          mask[:, :11], cache)
+        step_logits, _ = llama_mod.decode_step(params, cfg, embeds[:, 11:12], cache)
+        return np.asarray(step_logits)
+
+    ref = run(False)
+    got = run(True)
+    assert np.abs(got - ref).max() < 0.1 * (np.abs(ref).max() + 1)

@@ -39,9 +39,11 @@ def _trainer(toy_data, tmp_path, **targ_kw):
     targ_kw.setdefault("per_device_train_batch_size", 2)
     targ_kw.setdefault("mesh_data", 1)
     targ_kw.setdefault("mesh_fsdp", 2)  # dp=2 -> global batch 4 (= dataset)
+    targ_kw.setdefault("max_steps", 2)
+    targ_kw.setdefault("save_steps", -1)
     targs = TrainingArguments(
-        output_dir=str(tmp_path / "out"), max_steps=2,
-        logging_steps=1, save_steps=-1,
+        output_dir=str(tmp_path / "out"),
+        logging_steps=1,
         bf16=False, learning_rate=1e-2, **targ_kw,
     )
     return Trainer(
@@ -122,3 +124,43 @@ def test_grad_accum_counts_optimizer_steps(toy_data, tmp_path):
     # 2 optimizer steps x 2 micro-batches = 4 jitted step calls recorded
     # in the (micro-counting) device step counter.
     assert int(jax.device_get(tr.state.step)) == 4
+
+
+def test_find_latest_checkpoint(tmp_path):
+    from eventgpt_tpu.checkpoint import find_latest_checkpoint
+
+    assert find_latest_checkpoint(str(tmp_path / "missing")) is None
+    (tmp_path / "ckpt_last").mkdir()
+    assert find_latest_checkpoint(str(tmp_path)).endswith("ckpt_last")
+    (tmp_path / "ckpt_step3").mkdir()
+    (tmp_path / "ckpt_step12").mkdir()
+    assert find_latest_checkpoint(str(tmp_path)).endswith("ckpt_step12")
+
+
+def test_save_steps_then_auto_resume(toy_data, tmp_path):
+    """Crash-recovery recipe: a run that saved ckpt_step* restarts via
+    find_latest_checkpoint + resume and continues from the saved step."""
+    from eventgpt_tpu.checkpoint import find_latest_checkpoint
+
+    tr = _trainer(toy_data, tmp_path, stage=1, save_steps=1)
+    tr.train()  # max_steps=2, saves ckpt_step1, ckpt_step2, ckpt_last
+    latest = find_latest_checkpoint(tr.targs.output_dir)
+    assert latest.endswith("ckpt_step2")
+
+    tr2 = _trainer(toy_data, tmp_path, stage=1, save_steps=1)
+    tr2.resume(latest)
+    assert int(jax.device_get(tr2.state.step)) == 2
+
+
+def test_diverged_loss_raises(toy_data, tmp_path):
+    from eventgpt_tpu.train.trainer import TrainingDivergedError
+
+    tr = _trainer(toy_data, tmp_path, stage=1)
+    # Poison the projector master weights -> non-finite loss on step 1.
+    tr.state = tr.state._replace(
+        trainable=jax.tree_util.tree_map(
+            lambda x: x * np.nan, tr.state.trainable
+        )
+    )
+    with pytest.raises(TrainingDivergedError, match="resume_from auto"):
+        tr.train()
