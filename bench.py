@@ -21,7 +21,7 @@ becomes the baseline later rounds must beat):
 Model weights are zero/synthetic (throughput is data-independent for the
 matmul-bound loops); the input path is the REAL sample1.npy host pipeline.
 
-Flags: --preset {auto,7b,tiny} --decode_tokens N --batch N --quant {int8,bf16}
+Flags: --preset {auto,7b,tiny} --decode_tokens N --batch N --quant {int8,int4,bf16}
        --sweep  (decode batch sweep 1/2/4/8 into extras)
        --seq N --steps N --lora_r N  (train mode)
 """
@@ -63,8 +63,11 @@ def _build_params(cfg, dtype, quant: str):
     shapes = jax.eval_shape(
         lambda k: eventchat.init_eventchat_params(cfg, k, dtype), jax.random.PRNGKey(0)
     )
-    if quant == "int8":
-        qshapes = jax.eval_shape(quant_mod.quantize_llama_params, shapes["llama"])
+    if quant in ("int8", "int4"):
+        bits = 4 if quant == "int4" else 8
+        qshapes = jax.eval_shape(
+            lambda p: quant_mod.quantize_llama_params(p, bits=bits), shapes["llama"]
+        )
         return {
             "clip": _zeros_tree(shapes["clip"]),
             "projector": _zeros_tree(shapes["projector"]),
@@ -299,7 +302,7 @@ def main() -> None:
     p.add_argument("--preset", default="auto", choices=["auto", "7b", "tiny"])
     p.add_argument("--decode_tokens", type=int, default=64)
     p.add_argument("--batch", type=int, default=1)
-    p.add_argument("--quant", default="int8", choices=["int8", "bf16"])
+    p.add_argument("--quant", default="int8", choices=["int8", "int4", "bf16"])
     p.add_argument("--kv", default="bf16", choices=["bf16", "int8"],
                    help="decode KV cache storage")
     p.add_argument("--sweep", action="store_true")

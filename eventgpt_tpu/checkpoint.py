@@ -104,28 +104,33 @@ def save_component(path: str, tree: Params, prefix: str = "") -> None:
 
 
 def find_latest_checkpoint(output_dir: str) -> Optional[str]:
-    """Most recent checkpoint under ``output_dir``: the highest
-    ``ckpt_step{N}``, else ``ckpt_last``, else None.
+    """Most recent checkpoint under ``output_dir``, or None.
 
     The restart-after-failure recipe (``--resume_from auto``): a crashed or
     preempted run re-launches with the same command and continues from the
     last durable state — the TPU-era replacement for the reference stack's
     (absent) recovery story, SURVEY.md §5 "Failure detection".
+
+    The most recently written checkpoint wins, so a preemption checkpoint
+    taken after the last periodic save is preferred, and a stale
+    ``ckpt_preempt`` from an older incarnation loses to newer step saves.
+    Only COMPLETED checkpoint names are eligible (``ckpt_step{N}``,
+    ``ckpt_last``, ``ckpt_preempt`` exactly): orbax writes in-progress saves
+    to a sibling ``*.orbax-checkpoint-tmp-*`` directory, and a run killed
+    mid-save must not hand that half-written state to the relaunch.
     """
     import re
 
     if not os.path.isdir(output_dir):
         return None
-    best_step, best = -1, None
-    for name in os.listdir(output_dir):
-        m = re.fullmatch(r"ckpt_step(\d+)", name)
-        if m and int(m.group(1)) > best_step:
-            best_step, best = int(m.group(1)), os.path.join(output_dir, name)
-    if best is None:
-        last = os.path.join(output_dir, "ckpt_last")
-        if os.path.isdir(last):
-            return last
-    return best
+    candidates = [
+        os.path.join(output_dir, name) for name in os.listdir(output_dir)
+        if re.fullmatch(r"ckpt_(step\d+|last|preempt)", name)
+        and os.path.isdir(os.path.join(output_dir, name))
+    ]
+    if not candidates:
+        return None
+    return max(candidates, key=os.path.getmtime)
 
 
 def load_component(path: str, strip_prefix: str = "") -> Params:

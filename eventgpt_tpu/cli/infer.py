@@ -64,8 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attn_impl", type=str, default=None,
                    choices=["dense", "flash"],
                    help="prefill attention kernel (default: flash on TPU)")
-    p.add_argument("--quant", type=str, default="none", choices=["none", "int8"],
-                   help="weight-only quantization of the LM matmuls")
+    p.add_argument("--quant", type=str, default="none",
+                   choices=["none", "int8", "int4"],
+                   help="weight-only quantization of the LM matmuls (int4: "
+                        "group-128 packed nibbles, half int8's HBM traffic)")
     p.add_argument("--kv_cache", type=str, default="bf16", choices=["bf16", "int8"],
                    help="KV cache storage (int8 halves cache memory/bandwidth)")
     p.add_argument("--timing", action="store_true", help="print stage timings to stderr")
@@ -107,6 +109,8 @@ def place_params(tree, jdt):
 
     if quant_mod.is_quantized(tree):
         return {"q": jnp.asarray(tree["q"]), "s": jnp.asarray(tree["s"], jnp.float32)}
+    if quant_mod.is_quantized4(tree):
+        return {"q4": jnp.asarray(tree["q4"]), "s": jnp.asarray(tree["s"], jnp.float32)}
     if isinstance(tree, dict):
         return {k: place_params(v, jdt) for k, v in tree.items()}
     if isinstance(tree, (list, tuple)):
@@ -138,14 +142,15 @@ def main(argv=None) -> str:
         )
     if len(tokenizer) > cfg.llama.vocab_size:
         params["llama"] = resize_token_embeddings(params["llama"], len(tokenizer))
-    if args.quant == "int8":
+    if args.quant in ("int8", "int4"):
         # After embedding resize — quantized leaves are {"q","s"} dicts that
         # resize_token_embeddings cannot grow. Host-side: never holds the
-        # bf16 and int8 trees in HBM together.
+        # bf16 and quantized trees in HBM together.
         from eventgpt_tpu.ops.quant import quantize_llama_params
 
         params["llama"] = quantize_llama_params(
-            jax.tree_util.tree_map(np.asarray, params["llama"]), host=True
+            jax.tree_util.tree_map(np.asarray, params["llama"]), host=True,
+            bits=4 if args.quant == "int4" else 8,
         )
     import jax.numpy as jnp
 

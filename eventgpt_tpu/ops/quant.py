@@ -27,10 +27,15 @@ from typing import Any, Dict
 import jax.numpy as jnp
 
 QuantizedLeaf = Dict[str, jnp.ndarray]  # {"q": int8 [..., K, N], "s": f32 [..., 1, N]}
+# int4 leaf: {"q4": uint8 [..., K/2, N], "s": f32 [..., K/G, N]} — see quantize_tensor4.
 
 
 def is_quantized(leaf: Any) -> bool:
     return isinstance(leaf, dict) and "q" in leaf and "s" in leaf
+
+
+def is_quantized4(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and "q4" in leaf and "s" in leaf
 
 
 def is_lora(leaf: Any) -> bool:
@@ -56,6 +61,87 @@ def dequantize_tensor(leaf: QuantizedLeaf, dtype=jnp.float32) -> jnp.ndarray:
     return (leaf["q"].astype(jnp.float32) * leaf["s"]).astype(dtype)
 
 
+def quantize_tensor4(w: jnp.ndarray, group: int = 128) -> QuantizedLeaf:
+    """Group-wise symmetric int4 quantization of a (K, N) matmul weight,
+    packed two rows per byte.
+
+    Packing is along the CONTRACTION axis: byte ``[r, n]`` holds rows
+    ``2r`` (high nibble) and ``2r+1`` (low nibble), stored offset-binary
+    (``value + 8``). That layout needs **no interleave at unpack time** —
+    ``x @ W == x[0::2] @ hi_plane + x[1::2] @ lo_plane`` where each plane is
+    a plain shift/mask of the packed bytes, so the dequantize stays a fusable
+    elementwise producer feeding the dot (HBM streams 0.5 bytes/weight).
+
+    Scales are per (group, out-channel): ``s[g, n] = max|w[gG:(g+1)G, n]|/7``
+    over ``group`` contraction rows (int4's range is too coarse for the
+    per-channel scheme int8 uses). ``group`` must divide K and be even;
+    ``group=0`` means one group (per-channel).
+    """
+    K, N = w.shape[-2], w.shape[-1]
+    if group <= 0:
+        group = K
+    if K % group or group % 2:
+        raise ValueError(f"group {group} must be even and divide K={K}")
+    w32 = w.astype(jnp.float32)
+    gshape = w32.shape[:-2] + (K // group, group, N)
+    wg = w32.reshape(gshape)
+    amax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)  # (..., K/G, 1, N)
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(wg / scale), -8, 7).astype(jnp.int32).reshape(
+        w32.shape[:-2] + (K, N)
+    )
+    even, odd = q[..., 0::2, :] + 8, q[..., 1::2, :] + 8
+    packed = ((even << 4) | odd).astype(jnp.uint8)  # (..., K/2, N)
+    return {"q4": packed, "s": scale[..., 0, :]}  # s: (..., K/G, N)
+
+
+def _unpack4(q4: jnp.ndarray, dtype) -> tuple:
+    """Packed (..., K/2, N) uint8 -> (hi, lo) planes of the same shape in
+    ``dtype``: hi = even contraction rows, lo = odd."""
+    hi = (q4 >> 4).astype(jnp.int8) - 8
+    lo = (q4 & 0xF).astype(jnp.int8) - 8
+    return hi.astype(dtype), lo.astype(dtype)
+
+
+def dequantize_tensor4(leaf: QuantizedLeaf, dtype=jnp.float32) -> jnp.ndarray:
+    hi, lo = _unpack4(leaf["q4"], jnp.float32)
+    *lead, half_k, n = hi.shape
+    k = 2 * half_k
+    w = jnp.stack([hi, lo], axis=-2)  # (..., K/2, 2, N)
+    w = w.reshape(*lead, k, n)
+    gc = leaf["s"].shape[-2]
+    w = w.reshape(*lead, gc, k // gc, n) * leaf["s"][..., :, None, :]
+    return w.reshape(*lead, k, n).astype(dtype)
+
+
+def _matmul4(x: jnp.ndarray, leaf: QuantizedLeaf) -> jnp.ndarray:
+    """x (..., K) @ int4 leaf -> (..., N) f32 accumulator.
+
+    Grouped contraction: per group g, partial = xe_g @ hi_g + xo_g @ lo_g
+    (f32 accumulation on the MXU), then the per-(group, channel) scale
+    applies to the partials and the group axis sums out. All elementwise
+    work (nibble shift/mask, scale) stays a producer/consumer of the dots,
+    so XLA fuses it into the weight stream."""
+    q4, s = leaf["q4"], leaf["s"]
+    if q4.ndim != 2:
+        raise ValueError("int4 matmul expects a per-layer (K/2, N) plane; "
+                         "stacked trees are sliced by the layer scan")
+    half_k, n = q4.shape
+    k = 2 * half_k
+    gc = s.shape[-2]
+    hg = half_k // gc  # packed rows per group
+    hi, lo = _unpack4(q4, x.dtype)
+    lead = x.shape[:-1]
+    xg = x.reshape(-1, gc, hg, 2)  # (..., g, packed-row, parity)
+    xe, xo = xg[..., 0], xg[..., 1]
+    part = jnp.einsum("bgk,gkn->bgn", xe, hi.reshape(gc, hg, n),
+                      preferred_element_type=jnp.float32)
+    part += jnp.einsum("bgk,gkn->bgn", xo, lo.reshape(gc, hg, n),
+                       preferred_element_type=jnp.float32)
+    y = jnp.einsum("bgn,gn->bn", part, s, preferred_element_type=jnp.float32)
+    return y.reshape(*lead, n)
+
+
 def matmul(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     """x @ w for a plain or quantized weight leaf.
 
@@ -66,6 +152,8 @@ def matmul(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     if is_lora(w):
         delta = jnp.matmul(x, w["a"].astype(x.dtype)) @ w["b"].astype(x.dtype)
         return matmul(x, w["w"]) + delta
+    if is_quantized4(w):
+        return _matmul4(x, w).astype(x.dtype)
     if is_quantized(w):
         y = jnp.matmul(
             x, w["q"].astype(x.dtype), preferred_element_type=jnp.float32
@@ -79,6 +167,8 @@ def matmul_f32_out(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     if is_lora(w):
         delta = jnp.matmul(x, w["a"].astype(x.dtype)) @ w["b"].astype(x.dtype)
         return matmul_f32_out(x, w["w"]) + delta.astype(jnp.float32)
+    if is_quantized4(w):
+        return _matmul4(x, w)
     if is_quantized(w):
         y = jnp.matmul(
             x, w["q"].astype(x.dtype), preferred_element_type=jnp.float32
@@ -104,13 +194,51 @@ def quantize_tensor_host(w) -> QuantizedLeaf:
     return {"q": q, "s": scale.astype(np.float32)}
 
 
-def quantize_llama_params(params: Dict[str, Any], host: bool = False) -> Dict[str, Any]:
+def quantize_tensor4_host(w, group: int = 128) -> QuantizedLeaf:
+    """Numpy-side ``quantize_tensor4`` (same rationale as
+    ``quantize_tensor_host``: quantize before device placement)."""
+    import numpy as np
+
+    K, N = w.shape[-2], w.shape[-1]
+    if group <= 0:
+        group = K
+    if K % group or group % 2:
+        raise ValueError(f"group {group} must be even and divide K={K}")
+    w32 = np.asarray(w, np.float32)
+    wg = w32.reshape(w32.shape[:-2] + (K // group, group, N))
+    amax = np.max(np.abs(wg), axis=-2, keepdims=True)
+    scale = np.maximum(amax, 1e-8) / 7.0
+    q = np.clip(np.round(wg / scale), -8, 7).astype(np.int32).reshape(
+        w32.shape[:-2] + (K, N)
+    )
+    even, odd = q[..., 0::2, :] + 8, q[..., 1::2, :] + 8
+    packed = ((even << 4) | odd).astype(np.uint8)
+    return {"q4": packed, "s": scale[..., 0, :].astype(np.float32)}
+
+
+def quantize_llama_params(params: Dict[str, Any], host: bool = False,
+                          bits: int = 8, group: int = 128) -> Dict[str, Any]:
     """Quantize every matmul weight of a llama param tree (embeddings and
     norms untouched). Stacked-layer leaves (L, K, N) quantize per layer and
     channel; the scan over layers slices ``q``/``s`` together.
 
-    ``host=True`` runs the numpy path (see ``quantize_tensor_host``)."""
-    qt = quantize_tensor_host if host else quantize_tensor
+    ``host=True`` runs the numpy path (see ``quantize_tensor_host``);
+    ``bits=4`` selects the packed group-wise int4 scheme (``group`` rows per
+    scale)."""
+    if bits == 4:
+        # Per-leaf group clamp: leaves whose contraction dim is smaller than
+        # (or not divisible by) the requested group fall back to one group
+        # over the whole K (per-channel) — small models stay quantizable
+        # without the caller knowing every layer's K.
+        def qt(w):
+            k = w.shape[-2]
+            g = group if group > 0 and k % group == 0 else k
+            return (quantize_tensor4_host(w, g) if host
+                    else quantize_tensor4(w, g))
+    elif bits == 8:
+        qt = quantize_tensor_host if host else quantize_tensor
+    else:
+        raise ValueError(f"unsupported bits={bits} (4 or 8)")
     out = {k: v for k, v in params.items()}
     out["lm_head"] = qt(params["lm_head"])
     layers = dict(params["layers"])

@@ -67,6 +67,20 @@ class TrainingArguments:
     lora_dropout: float = 0.0
     lora_weight_path: str = ""
     lora_bias: str = "none"
+    # Failure handling (train/resilience.py): "raise" fails loudly on
+    # non-finite loss; "rewind" reloads the latest checkpoint and continues
+    # with a reshuffled batch order, at most max_divergence_rewinds times.
+    on_divergence: str = "raise"
+    max_divergence_rewinds: int = 2
+    # Multi-host preemption agreement cadence (micro-batches): the shutdown
+    # flag needs a cross-host allgather so every host checkpoints at the same
+    # boundary, but doing that every micro-batch would fence async dispatch —
+    # poll every N micros instead (single process always polls locally, free).
+    preempt_poll_micros: int = 8
+    # Liveness cadence independent of logging_steps: heartbeat.json updates
+    # at least this often (seconds) while steps complete, so watchdogs can
+    # pick a staleness timeout without knowing the logging config.
+    heartbeat_interval_s: float = 30.0
     # Mesh
     mesh_data: int = -1                 # -1 -> auto (best_mesh_config)
     mesh_fsdp: int = -1

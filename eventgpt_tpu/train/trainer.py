@@ -34,6 +34,7 @@ from eventgpt_tpu.train.args import DataArguments, ModelArguments, TrainingArgum
 from eventgpt_tpu.train.data import EventChatDataset, batch_iterator
 from eventgpt_tpu.train.lora import LoraConfig, lora_param_specs
 from eventgpt_tpu.train.optim import linear_warmup_cosine, make_optimizer
+from eventgpt_tpu.train.resilience import GracefulShutdown, Heartbeat
 
 log = logging.getLogger("eventgpt_tpu.train")
 
@@ -219,6 +220,13 @@ class Trainer:
             cfg, self.optimizer, self.combine, mesh=mesh
         )
         self.metrics_path = os.path.join(train_args.output_dir, "metrics.jsonl")
+        self.heartbeat = Heartbeat(train_args.output_dir)
+        self._last_ckpt: Optional[str] = None
+        if train_args.on_divergence not in ("raise", "rewind"):
+            raise ValueError(
+                f"on_divergence must be 'raise' or 'rewind', "
+                f"got {train_args.on_divergence!r}"
+            )
 
     # ------------------------------------------------------------------
     def _log(self, record: Dict[str, Any]) -> None:
@@ -239,6 +247,7 @@ class Trainer:
             "opt_state": self.state.opt_state,
             "step": self.state.step,
         })
+        self._last_ckpt = out
         if is_primary():
             if "projector" in self.state.trainable:
                 ckpt.save_component(
@@ -261,13 +270,52 @@ class Trainer:
             "step": self.state.step,
         }
         restored = ckpt.load_checkpoint(path, target)
+        # Orbax restores every leaf COMMITTED to its target sharding. Leaves
+        # that were never mesh-sharded (optimizer counts/scalars, created
+        # eagerly by optax.init) restore committed to a single device, which
+        # a later train_step on the multi-device mesh rejects as a device
+        # mismatch — re-place those as mesh-replicated.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def replicate_unsharded(leaf):
+            if not hasattr(leaf, "sharding") or isinstance(
+                leaf.sharding, NamedSharding
+            ):
+                return leaf
+            return jax.device_put(
+                leaf, NamedSharding(self.mesh, PartitionSpec())
+            )
+
+        restored = jax.tree_util.tree_map(replicate_unsharded, restored)
         self.state = steps_mod.TrainState(
             restored["trainable"], self.state.frozen,
             restored["opt_state"], restored["step"],
         )
+        self._last_ckpt = path
 
     # ------------------------------------------------------------------
-    def train(self) -> Dict[str, float]:
+    def train(self, shutdown: Optional[GracefulShutdown] = None) -> Dict[str, float]:
+        """Run the training loop.
+
+        ``shutdown`` (a pre-armed ``GracefulShutdown``) is injectable for
+        fault-injection tests; by default one is installed here so SIGTERM/
+        SIGINT preemption checkpoints ``ckpt_preempt`` and returns cleanly
+        (``{"preempted": True}`` in the result; relaunch with
+        ``--resume_from auto``). Non-finite loss follows
+        ``TrainingArguments.on_divergence``: ``"raise"`` (default) or
+        ``"rewind"`` — reload the latest checkpoint and continue with a
+        reshuffled batch order, at most ``max_divergence_rewinds`` times.
+        """
+        own_shutdown = shutdown is None
+        if own_shutdown:
+            shutdown = GracefulShutdown().install()
+        try:
+            return self._train_loop(shutdown)
+        finally:
+            if own_shutdown:
+                shutdown.uninstall()
+
+    def _train_loop(self, shutdown: GracefulShutdown) -> Dict[str, float]:
         targs = self.targs
         accum = max(targs.gradient_accumulation_steps, 1)
         # state.step counts micro-batches (it ticks inside the jitted step);
@@ -278,6 +326,9 @@ class Trainer:
         last_metrics: Dict[str, float] = {}
         t_start = time.perf_counter()
         tokens_seen = 0
+        rewinds = 0
+        ckpt_tokens: Dict[str, int] = {}  # tokens_seen at each save point
+        last_beat = 0.0
 
         if len(self.dataset) < self.global_batch_size:
             raise ValueError(
@@ -290,18 +341,38 @@ class Trainer:
         # With max_steps > 0, cycle epochs until the step budget is spent
         # (HF Trainer semantics); otherwise run num_train_epochs exactly.
         epochs = targs.num_train_epochs if targs.max_steps <= 0 else 10**9
-        for epoch in range(epochs):
+        epoch = -1
+        while epoch + 1 < epochs:
+            epoch += 1
             if done:
                 break
             it = batch_iterator(
                 self.dataset, self.global_batch_size, self.cfg,
-                shuffle=True, seed=targs.seed + epoch,
+                # + rewinds: a divergence rewind replays from the checkpoint
+                # with a DIFFERENT shuffle, so a poisonous batch order is not
+                # deterministically re-entered.
+                shuffle=True, seed=targs.seed + epoch + 1000 * rewinds,
                 group_by_modality_length=targs.group_by_modality_length,
                 max_len=targs.model_max_length,
             )
             window: list = []  # (loss, grad_norm) device scalars, one per micro
             t_window = time.perf_counter()
+            diverged = False
             for host_batch in it:
+                # Local flag check is free; the cross-host AGREEMENT collective
+                # (globally_requested) only runs every preempt_poll_micros so
+                # multi-host runs don't fence async dispatch per micro-batch.
+                # All hosts share the micro counter, so they poll (and thus
+                # act) at the same boundary.
+                poll = (jax.process_count() == 1
+                        or micro % max(targs.preempt_poll_micros, 1) == 0)
+                if poll and shutdown.globally_requested():
+                    self.save("preempt")
+                    last_metrics = {**last_metrics, "preempted": True,
+                                    "reason": shutdown.reason, "step": step}
+                    self._log({"event": "preempt", "reason": shutdown.reason,
+                               "step": step})
+                    return last_metrics
                 batch = steps_mod.batch_to_device(host_batch, self.mesh)
                 self.state, metrics = self.train_step(self.state, batch)
                 micro += 1
@@ -311,36 +382,70 @@ class Trainer:
                     continue  # gradients still accumulating
                 step += 1
 
-                if step % targs.logging_steps == 0 or step == 1:
+                need_log = step % targs.logging_steps == 0 or step == 1
+                need_save = targs.save_steps > 0 and step % targs.save_steps == 0
+                if need_log or need_save:
                     # Mean over the accumulation window (HF reports per
                     # optimizer step, not last-micro-batch noise). Host
-                    # readback only on logging steps — an unconditional
-                    # device_get would fence async dispatch every step.
+                    # readback only on logging/save steps — an unconditional
+                    # device_get would fence async dispatch every step. Save
+                    # steps read the loss too, so a checkpoint is never
+                    # written from a window that already went non-finite
+                    # (rewind would otherwise reload poisoned state).
                     loss = float(jax.device_get(sum(w[0] for w in window))) / len(window)
                     gnorm = float(jax.device_get(sum(w[1] for w in window))) / len(window)
                     if not math.isfinite(loss):
-                        # Piggybacks on the logging readback (no extra fence):
-                        # fail loudly with the recovery recipe instead of
-                        # silently corrupting every later step.
+                        if (targs.on_divergence == "rewind"
+                                and rewinds < targs.max_divergence_rewinds
+                                and self._last_ckpt):
+                            rewinds += 1
+                            self._log({"event": "divergence_rewind",
+                                       "step": step, "loss": loss,
+                                       "rewind": rewinds,
+                                       "checkpoint": self._last_ckpt})
+                            self.resume(self._last_ckpt)
+                            micro = int(jax.device_get(self.state.step))
+                            step = micro // accum
+                            # Discarded steps' tokens don't count twice in
+                            # tokens_per_s (replay re-counts them).
+                            tokens_seen = ckpt_tokens.get(self._last_ckpt,
+                                                          tokens_seen)
+                            diverged = True
+                            break  # new epoch iterator, reshuffled
                         raise TrainingDivergedError(
                             f"non-finite loss {loss} at optimizer step {step}; "
                             f"restart with --resume_from auto to continue from "
                             f"the last checkpoint in {targs.output_dir}"
                         )
-                    dt = time.perf_counter() - t_window
-                    last_metrics = {
-                        "step": step, "epoch": epoch, "loss": loss,
-                        "grad_norm": gnorm,
-                        "step_time_s": round(dt, 4),
-                        "tokens_per_s": round(tokens_seen / (time.perf_counter() - t_start), 1),
-                    }
-                    self._log(last_metrics)
+                    if need_log:
+                        dt = time.perf_counter() - t_window
+                        last_metrics = {
+                            "step": step, "epoch": epoch, "loss": loss,
+                            "grad_norm": gnorm,
+                            "step_time_s": round(dt, 4),
+                            "tokens_per_s": round(tokens_seen / (time.perf_counter() - t_start), 1),
+                        }
+                        self._log(last_metrics)
                 window.clear()
                 t_window = time.perf_counter()
-                if targs.save_steps > 0 and step % targs.save_steps == 0:
+                # Liveness beat on its own time cadence (not logging_steps):
+                # watchdogs need a staleness bound independent of logging
+                # config. Loss rides along only when this step logged one.
+                now = time.perf_counter()
+                if is_primary() and (
+                    need_log or now - last_beat > targs.heartbeat_interval_s
+                ):
+                    self.heartbeat.beat(step, **({"loss": loss} if need_log else {}))
+                    last_beat = now
+                if need_save:
                     self.save(f"step{step}")
+                    ckpt_tokens[self._last_ckpt] = tokens_seen
                 if 0 < targs.max_steps <= step:
                     done = True
                     break
+            if diverged:
+                # Replay the epoch range from the restored step; the epoch
+                # counter stays (rewinds bump the shuffle seed instead).
+                epoch -= 1
         self.save("last")
         return last_metrics
