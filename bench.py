@@ -13,8 +13,15 @@ becomes the baseline later rounds must beat):
                  ``lax.while_loop`` decode of ``eventchat.generate`` (one
                  dispatch for the whole budget). ``--quant int8`` (default)
                  streams weight-only int8 — the structural fix for
-                 bandwidth-bound batch-1 decode (1.59x measured on v5e);
-                 ``--quant bf16`` measures the unquantized path.
+                 bandwidth-bound batch-1 decode; with the KV cache carried
+                 in-place through the layer scan this reaches ~83% of the
+                 weight-bandwidth bound on v5e (84 tok/s; device-side ~96,
+                 the rest is per-dispatch tunnel overhead). ``--quant int4``
+                 exists but measures SLOWER (34.9 tok/s via the Pallas
+                 kernel: v5e has no int4 memory path, so nibble unpack is
+                 VPU-bound; plain XLA is worse still at 16.5 — it
+                 materializes the unpack through HBM). ``--quant bf16``
+                 measures the unquantized path (44.8).
   --mode train   stage-2 (LoRA + projector) jitted train-step time at 7B,
                  batch/seq sized for one chip.
 
@@ -52,28 +59,32 @@ def _zeros_tree(shapes):
     return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
-def _build_params(cfg, dtype, quant: str):
+def _build_params(cfg, dtype, quant: str, fuse: bool = False):
     """Zero-filled param tree; int8 trees are synthesized at the quantized
-    shapes directly so HBM never holds bf16 + int8 copies at once."""
+    shapes directly so HBM never holds bf16 + int8 copies at once. ``fuse``
+    concatenates qkv / gate-up before quantization (fewer, wider decode
+    dots — ``models/llama.py:fuse_llama_params``)."""
     import jax
 
-    from eventgpt_tpu.models import eventchat
+    from eventgpt_tpu.models import eventchat, llama as llama_mod
     from eventgpt_tpu.ops import quant as quant_mod
 
     shapes = jax.eval_shape(
         lambda k: eventchat.init_eventchat_params(cfg, k, dtype), jax.random.PRNGKey(0)
     )
-    if quant in ("int8", "int4"):
-        bits = 4 if quant == "int4" else 8
-        qshapes = jax.eval_shape(
-            lambda p: quant_mod.quantize_llama_params(p, bits=bits), shapes["llama"]
-        )
-        return {
-            "clip": _zeros_tree(shapes["clip"]),
-            "projector": _zeros_tree(shapes["projector"]),
-            "llama": _zeros_tree(qshapes),
-        }
-    return _zeros_tree(shapes)
+    def transform(p):
+        if fuse:
+            p = llama_mod.fuse_llama_params(p)
+        if quant in ("int8", "int4"):
+            p = quant_mod.quantize_llama_params(p, bits=4 if quant == "int4" else 8)
+        return p
+
+    qshapes = jax.eval_shape(transform, shapes["llama"])
+    return {
+        "clip": _zeros_tree(shapes["clip"]),
+        "projector": _zeros_tree(shapes["projector"]),
+        "llama": _zeros_tree(qshapes),
+    }
 
 
 def _event_pixels(cfg, batch):
@@ -129,7 +140,8 @@ def run_decode(args) -> None:
         preset = "7b" if platform == "tpu" else "tiny"
     cfg = EventChatConfig.eventgpt_7b() if preset == "7b" else EventChatConfig.tiny()
     dtype = jnp.bfloat16
-    params = _build_params(cfg, dtype, args.quant if preset == "7b" else "bf16")
+    params = _build_params(cfg, dtype, args.quant if preset == "7b" else "bf16",
+                           fuse=args.fuse)
 
     pixels = jnp.asarray(_event_pixels(cfg, 1), dtype)
     ids = [1] + [7] * 34 + [-200] + [9] * 16
@@ -303,6 +315,8 @@ def main() -> None:
     p.add_argument("--decode_tokens", type=int, default=64)
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--quant", default="int8", choices=["int8", "int4", "bf16"])
+    p.add_argument("--fuse", action=argparse.BooleanOptionalAction, default=False,
+                   help="fuse qkv / gate-up projections before quantization")
     p.add_argument("--kv", default="bf16", choices=["bf16", "int8"],
                    help="decode KV cache storage")
     p.add_argument("--sweep", action="store_true")

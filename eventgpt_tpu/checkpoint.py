@@ -111,26 +111,47 @@ def find_latest_checkpoint(output_dir: str) -> Optional[str]:
     last durable state — the TPU-era replacement for the reference stack's
     (absent) recovery story, SURVEY.md §5 "Failure detection".
 
-    The most recently written checkpoint wins, so a preemption checkpoint
-    taken after the last periodic save is preferred, and a stale
-    ``ckpt_preempt`` from an older incarnation loses to newer step saves.
-    Only COMPLETED checkpoint names are eligible (``ckpt_step{N}``,
-    ``ckpt_last``, ``ckpt_preempt`` exactly): orbax writes in-progress saves
-    to a sibling ``*.orbax-checkpoint-tmp-*`` directory, and a run killed
-    mid-save must not hand that half-written state to the relaunch.
+    Ordering: the STEP NUMBER in the name is the primary key
+    (``ckpt_step{N}``; ``ckpt_preempt_step{N}`` wins a tie at the same N
+    since preemption strikes after the periodic save). mtime is only the
+    arbiter for the unnumbered names ``ckpt_last`` / legacy
+    ``ckpt_preempt`` — it must never order step checkpoints, because
+    directory mtimes are synthetic on gcsfuse-style filesystems and lost by
+    rsync, and resuming from a mis-ordered step save silently discards
+    training. Only COMPLETED checkpoint names are eligible: orbax writes
+    in-progress saves to a sibling ``*.orbax-checkpoint-tmp-*`` directory,
+    and a run killed mid-save must not hand that half-written state to the
+    relaunch.
     """
     import re
 
     if not os.path.isdir(output_dir):
         return None
-    candidates = [
-        os.path.join(output_dir, name) for name in os.listdir(output_dir)
-        if re.fullmatch(r"ckpt_(step\d+|last|preempt)", name)
-        and os.path.isdir(os.path.join(output_dir, name))
-    ]
-    if not candidates:
-        return None
-    return max(candidates, key=os.path.getmtime)
+
+    def mtime(p):
+        try:
+            return os.path.getmtime(p)
+        except OSError:
+            return 0.0
+
+    best_step = (-1, -1, None)  # (step, preempt-tiebreak, path)
+    unnumbered = []
+    for name in os.listdir(output_dir):
+        path = os.path.join(output_dir, name)
+        if not os.path.isdir(path):
+            continue
+        m = re.fullmatch(r"ckpt_(preempt_)?step(\d+)", name)
+        if m:
+            key = (int(m.group(2)), 1 if m.group(1) else 0, path)
+            if key[:2] > best_step[:2]:
+                best_step = key
+        elif re.fullmatch(r"ckpt_(last|preempt)", name):
+            unnumbered.append(path)
+    best = best_step[2]
+    for path in unnumbered:
+        if best is None or mtime(path) >= mtime(best):
+            best = path
+    return best
 
 
 def load_component(path: str, strip_prefix: str = "") -> Params:

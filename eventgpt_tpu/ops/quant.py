@@ -48,13 +48,19 @@ def is_lora(leaf: Any) -> bool:
     return isinstance(leaf, dict) and "w" in leaf and "a" in leaf and "b" in leaf
 
 
+def _quantize8_impl(w, xp) -> QuantizedLeaf:
+    """Shared int8 math, parameterized on the array namespace (jnp on
+    device, numpy on host) so the two paths cannot drift."""
+    w32 = xp.asarray(w).astype(xp.float32)
+    amax = xp.max(xp.abs(w32), axis=-2, keepdims=True)  # (..., 1, N)
+    scale = xp.maximum(amax, 1e-8) / 127.0
+    q = xp.clip(xp.round(w32 / scale), -127, 127).astype(xp.int8)
+    return {"q": q, "s": scale.astype(xp.float32)}
+
+
 def quantize_tensor(w: jnp.ndarray) -> QuantizedLeaf:
     """Quantize a (..., K, N) matmul weight per output channel (axis -1)."""
-    w32 = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)  # (..., 1, N)
-    scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
-    return {"q": q, "s": scale}
+    return _quantize8_impl(w, jnp)
 
 
 def dequantize_tensor(leaf: QuantizedLeaf, dtype=jnp.float32) -> jnp.ndarray:
@@ -77,22 +83,28 @@ def quantize_tensor4(w: jnp.ndarray, group: int = 128) -> QuantizedLeaf:
     per-channel scheme int8 uses). ``group`` must divide K and be even;
     ``group=0`` means one group (per-channel).
     """
+    return _quantize4_impl(w, group, jnp)
+
+
+def _quantize4_impl(w, group: int, xp) -> QuantizedLeaf:
+    """Shared int4 math, parameterized on the array namespace (jnp on
+    device, numpy on host) so the two paths cannot drift."""
     K, N = w.shape[-2], w.shape[-1]
     if group <= 0:
         group = K
     if K % group or group % 2:
         raise ValueError(f"group {group} must be even and divide K={K}")
-    w32 = w.astype(jnp.float32)
+    w32 = xp.asarray(w).astype(xp.float32)
     gshape = w32.shape[:-2] + (K // group, group, N)
     wg = w32.reshape(gshape)
-    amax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)  # (..., K/G, 1, N)
-    scale = jnp.maximum(amax, 1e-8) / 7.0
-    q = jnp.clip(jnp.round(wg / scale), -8, 7).astype(jnp.int32).reshape(
+    amax = xp.max(xp.abs(wg), axis=-2, keepdims=True)  # (..., K/G, 1, N)
+    scale = xp.maximum(amax, 1e-8) / 7.0
+    q = xp.clip(xp.round(wg / scale), -8, 7).astype(xp.int32).reshape(
         w32.shape[:-2] + (K, N)
     )
     even, odd = q[..., 0::2, :] + 8, q[..., 1::2, :] + 8
-    packed = ((even << 4) | odd).astype(jnp.uint8)  # (..., K/2, N)
-    return {"q4": packed, "s": scale[..., 0, :]}  # s: (..., K/G, N)
+    packed = ((even << 4) | odd).astype(xp.uint8)  # (..., K/2, N)
+    return {"q4": packed, "s": scale[..., 0, :].astype(xp.float32)}  # (..., K/G, N)
 
 
 def _unpack4(q4: jnp.ndarray, dtype) -> tuple:
@@ -117,12 +129,22 @@ def dequantize_tensor4(leaf: QuantizedLeaf, dtype=jnp.float32) -> jnp.ndarray:
 def _matmul4(x: jnp.ndarray, leaf: QuantizedLeaf) -> jnp.ndarray:
     """x (..., K) @ int4 leaf -> (..., N) f32 accumulator.
 
-    Grouped contraction: per group g, partial = xe_g @ hi_g + xo_g @ lo_g
-    (f32 accumulation on the MXU), then the per-(group, channel) scale
-    applies to the partials and the group axis sums out. All elementwise
-    work (nibble shift/mask, scale) stays a producer/consumer of the dots,
-    so XLA fuses it into the weight stream."""
+    Dispatches to the Pallas kernel (``ops/int4_matmul.py``) when the
+    shapes meet its alignment contract — XLA materializes the nibble
+    unpack through HBM, which defeats int4's whole purpose (measured
+    slower than int8); the kernel dequantizes in VMEM. The XLA grouped
+    two-plane einsum remains the fallback for unaligned (tiny-model)
+    shapes."""
     q4, s = leaf["q4"], leaf["s"]
+    if q4.ndim == 2:
+        from eventgpt_tpu.ops import int4_matmul as i4k
+
+        k = 2 * q4.shape[-2]
+        group = k // s.shape[-2]
+        if i4k.supported(k, q4.shape[-1], group):
+            lead = x.shape[:-1]
+            y = i4k.int4_matmul(x.reshape(-1, k), q4, s)
+            return y.reshape(*lead, q4.shape[-1])
     if q4.ndim != 2:
         raise ValueError("int4 matmul expects a per-layer (K/2, N) plane; "
                          "stacked trees are sliced by the layer scan")
@@ -187,11 +209,7 @@ def quantize_tensor_host(w) -> QuantizedLeaf:
     """
     import numpy as np
 
-    w32 = np.asarray(w, np.float32)
-    amax = np.max(np.abs(w32), axis=-2, keepdims=True)
-    scale = np.maximum(amax, 1e-8) / 127.0
-    q = np.clip(np.round(w32 / scale), -127, 127).astype(np.int8)
-    return {"q": q, "s": scale.astype(np.float32)}
+    return _quantize8_impl(w, np)
 
 
 def quantize_tensor4_host(w, group: int = 128) -> QuantizedLeaf:
@@ -199,21 +217,7 @@ def quantize_tensor4_host(w, group: int = 128) -> QuantizedLeaf:
     ``quantize_tensor_host``: quantize before device placement)."""
     import numpy as np
 
-    K, N = w.shape[-2], w.shape[-1]
-    if group <= 0:
-        group = K
-    if K % group or group % 2:
-        raise ValueError(f"group {group} must be even and divide K={K}")
-    w32 = np.asarray(w, np.float32)
-    wg = w32.reshape(w32.shape[:-2] + (K // group, group, N))
-    amax = np.max(np.abs(wg), axis=-2, keepdims=True)
-    scale = np.maximum(amax, 1e-8) / 7.0
-    q = np.clip(np.round(wg / scale), -8, 7).astype(np.int32).reshape(
-        w32.shape[:-2] + (K, N)
-    )
-    even, odd = q[..., 0::2, :] + 8, q[..., 1::2, :] + 8
-    packed = ((even << 4) | odd).astype(np.uint8)
-    return {"q4": packed, "s": scale[..., 0, :].astype(np.float32)}
+    return _quantize4_impl(w, group, np)
 
 
 def quantize_llama_params(params: Dict[str, Any], host: bool = False,

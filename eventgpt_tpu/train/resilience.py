@@ -56,6 +56,14 @@ class GracefulShutdown:
         self.reason = reason
 
     def _handler(self, signum, frame):
+        if self.requested:
+            # Second signal escalates: a hung step never reaches the poll,
+            # so restore the previous disposition and re-deliver — the
+            # operator's second Ctrl-C (or the scheduler's follow-up
+            # SIGTERM) must be able to kill a stuck run.
+            self.uninstall()
+            os.kill(os.getpid(), signum)
+            return
         self.request(signal.Signals(signum).name)
 
     def install(self) -> "GracefulShutdown":

@@ -367,7 +367,9 @@ class Trainer:
                 poll = (jax.process_count() == 1
                         or micro % max(targs.preempt_poll_micros, 1) == 0)
                 if poll and shutdown.globally_requested():
-                    self.save("preempt")
+                    # Step-numbered name so auto-resume can order it without
+                    # trusting filesystem mtimes (checkpoint.py ordering).
+                    self.save(f"preempt_step{step}")
                     last_metrics = {**last_metrics, "preempted": True,
                                     "reason": shutdown.reason, "step": step}
                     self._log({"event": "preempt", "reason": shutdown.reason,

@@ -99,10 +99,11 @@ def test_preemption_saves_checkpoint_and_resume_continues(toy_data, tmp_path):
     tr = _make_trainer(toy_data, out)
     result = tr.train(shutdown=_TriggerAfter(after=2))
     assert result.get("preempted") is True
-    preempt_dir = os.path.join(str(out), "ckpt_preempt")
-    assert os.path.isdir(preempt_dir)
     saved_step = int(jax.device_get(tr.state.step))
     assert 0 < saved_step < 4  # stopped mid-run, not at completion
+    # Step-numbered preempt checkpoint (ordering without trusting mtimes).
+    preempt_dir = os.path.join(str(out), f"ckpt_preempt_step{saved_step}")
+    assert os.path.isdir(preempt_dir)
 
     # Relaunch (fresh Trainer = fresh process equivalent) + auto-resume.
     from eventgpt_tpu.checkpoint import find_latest_checkpoint
@@ -193,6 +194,47 @@ def test_trainer_writes_heartbeat(toy_data, tmp_path):
 def test_invalid_divergence_policy_rejected(toy_data, tmp_path):
     with pytest.raises(ValueError, match="on_divergence"):
         _make_trainer(toy_data, tmp_path / "out", on_divergence="ignore")
+
+
+def test_find_latest_orders_by_step_not_mtime(tmp_path):
+    """Step number is the primary key: synthetic mtimes (gcsfuse, rsync)
+    must not reorder step checkpoints; ckpt_last/bare ckpt_preempt only win
+    via mtime against the best step save."""
+    import os as _os
+
+    from eventgpt_tpu.checkpoint import find_latest_checkpoint
+
+    (tmp_path / "ckpt_step9").mkdir()
+    (tmp_path / "ckpt_step1").mkdir()
+    # Make step1 artificially NEWER (the gcsfuse/rsync hazard).
+    _os.utime(tmp_path / "ckpt_step1", (2e9, 2e9))
+    assert find_latest_checkpoint(str(tmp_path)).endswith("ckpt_step9")
+    # Preempt at the same step wins the tie (written after the periodic save).
+    (tmp_path / "ckpt_preempt_step9").mkdir()
+    assert find_latest_checkpoint(str(tmp_path)).endswith("ckpt_preempt_step9")
+    # ckpt_last with a newer mtime than the best step save wins.
+    last = tmp_path / "ckpt_last"
+    last.mkdir()
+    _os.utime(last, (3e9, 3e9))
+    assert find_latest_checkpoint(str(tmp_path)).endswith("ckpt_last")
+
+
+def test_second_signal_escalates():
+    """First SIGUSR1 latches; the second restores the previous handler and
+    re-delivers (so a hung run stays killable). With a benign previous
+    handler the re-delivery must reach it."""
+    import signal as _signal
+
+    hits = []
+    prev = _signal.signal(_signal.SIGUSR1, lambda *a: hits.append("prev"))
+    try:
+        with GracefulShutdown(signals=(_signal.SIGUSR1,)) as sd:
+            os.kill(os.getpid(), _signal.SIGUSR1)
+            assert sd.requested and not hits
+            os.kill(os.getpid(), _signal.SIGUSR1)  # escalation
+            assert hits == ["prev"]
+    finally:
+        _signal.signal(_signal.SIGUSR1, prev)
 
 
 def test_find_latest_ignores_orbax_tmp_dirs(tmp_path):

@@ -144,6 +144,14 @@ def test_save_steps_then_auto_resume(toy_data, tmp_path):
 
     tr = _trainer(toy_data, tmp_path, stage=1, save_steps=1)
     tr.train()  # max_steps=2, saves ckpt_step1, ckpt_step2, ckpt_last
+    # Recency contract: the completed run's ckpt_last is the newest durable
+    # state (same content as ckpt_step2); a crashed run (no ckpt_last) falls
+    # back to the newest step checkpoint.
+    latest = find_latest_checkpoint(tr.targs.output_dir)
+    assert latest.endswith("ckpt_last")
+    import shutil
+
+    shutil.rmtree(latest)  # simulate a crash before the final save
     latest = find_latest_checkpoint(tr.targs.output_dir)
     assert latest.endswith("ckpt_step2")
 
