@@ -149,7 +149,10 @@ def find_latest_checkpoint(output_dir: str) -> Optional[str]:
             unnumbered.append(path)
     best = best_step[2]
     for path in unnumbered:
-        if best is None or mtime(path) >= mtime(best):
+        # Strict >: an mtime TIE (coarse/synthetic filesystem timestamps)
+        # must go to the step-numbered checkpoint — a stale ckpt_last from
+        # an older incarnation must never beat a newer step save.
+        if best is None or mtime(path) > mtime(best):
             best = path
     return best
 

@@ -71,6 +71,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv_cache", type=str, default="bf16", choices=["bf16", "int8"],
                    help="KV cache storage (int8 halves cache memory/bandwidth)")
     p.add_argument("--timing", action="store_true", help="print stage timings to stderr")
+    # Q-Former serving (the use_event_qformer surface): enable the gate and
+    # load the trained component artifacts written by the trainer
+    # (query_embedder_*.npz / attention_layers_*.npz, reference prefix
+    # conventions per model/EventChatModel.py:141-163).
+    p.add_argument("--use_event_qformer", action="store_true")
+    p.add_argument("--pretrain_query_embedder", type=str, default=None)
+    p.add_argument("--pretrain_attention_layers", type=str, default=None)
     return p
 
 
@@ -131,6 +138,33 @@ def main(argv=None) -> str:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, use_spatio_temporal_pool=args.spatial_temporal_encoder)
+    if args.use_event_qformer or cfg.use_event_qformer:
+        import dataclasses
+
+        from eventgpt_tpu.config import QFormerConfig
+        from eventgpt_tpu.models.qformer import (
+            init_qformer_params, load_qformer_components,
+        )
+
+        if not cfg.use_event_qformer:
+            qcfg = QFormerConfig(hidden_size=cfg.llama.hidden_size)
+            if args.pretrain_query_embedder or args.pretrain_attention_layers:
+                from eventgpt_tpu.models.qformer import qformer_config_from_artifacts
+
+                qcfg = qformer_config_from_artifacts(
+                    args.pretrain_query_embedder, args.pretrain_attention_layers
+                )
+            cfg = dataclasses.replace(cfg, use_event_qformer=True, qformer=qcfg)
+        if "qformer" not in params:
+            params["qformer"] = init_qformer_params(
+                cfg.qformer, jax.random.PRNGKey(args.seed + 1)
+            )
+        if args.pretrain_query_embedder or args.pretrain_attention_layers:
+            params["qformer"] = load_qformer_components(
+                params["qformer"],
+                query_embedder_path=args.pretrain_query_embedder,
+                attention_layers_path=args.pretrain_attention_layers,
+            )
 
     # Special-token registration parity with inference.py:33-39.
     if cfg.mm_use_im_patch_token:

@@ -71,11 +71,57 @@ def main(argv=None):
         margs.model_name_or_path, "bfloat16" if targs.bf16 else "float32"
     )
 
+    if margs.use_event_qformer and not cfg.use_event_qformer:
+        # CLI gate-in (initialize_vision_modules sets use_event_qformer on
+        # the config the same way, model/EventChatModel.py:117-121).
+        from eventgpt_tpu.config import QFormerConfig
+
+        cfg = dataclasses.replace(
+            cfg, use_event_qformer=True,
+            qformer=QFormerConfig(hidden_size=cfg.llama.hidden_size),
+        )
+    if cfg.use_event_qformer and "qformer" not in params:
+        # Covers both the CLI gate-in and checkpoints whose config.json
+        # already sets use_event_qformer (their state dicts never carry the
+        # weights — component files or fresh init fill them).
+        from eventgpt_tpu.models.qformer import init_qformer_params
+
+        params["qformer"] = init_qformer_params(
+            cfg.qformer, jax.random.PRNGKey(targs.seed + 1)
+        )
+
     if margs.pretrain_mm_mlp_adapter:
         from eventgpt_tpu import checkpoint as ckpt
 
         params["projector"] = ckpt.load_component(
             margs.pretrain_mm_mlp_adapter, strip_prefix="model.visual_projector."
+        )
+    if margs.pretrain_feature_adaptor:
+        from eventgpt_tpu import checkpoint as ckpt
+
+        params["projector"]["adaptor"] = ckpt.load_component(
+            margs.pretrain_feature_adaptor, strip_prefix="model.feature_adaptor."
+        )
+        if not cfg.projector.use_feature_adaptor:
+            # Keep the config in sync or the sharding-spec tree and the
+            # param tree disagree at Trainer construction.
+            cfg = dataclasses.replace(
+                cfg, projector=dataclasses.replace(
+                    cfg.projector, use_feature_adaptor=True
+                )
+            )
+    if margs.pretrain_query_embedder or margs.pretrain_attention_layers:
+        from eventgpt_tpu.models.qformer import load_qformer_components
+
+        if "qformer" not in params:
+            raise ValueError(
+                "pretrain_query_embedder/pretrain_attention_layers require "
+                "--use_event_qformer true (or a use_event_qformer checkpoint)"
+            )
+        params["qformer"] = load_qformer_components(
+            params["qformer"],
+            query_embedder_path=margs.pretrain_query_embedder,
+            attention_layers_path=margs.pretrain_attention_layers,
         )
 
     trainer = Trainer(cfg, params, tokenizer, margs, dargs, targs)

@@ -115,6 +115,25 @@ class ProjectorConfig:
 
 
 @dataclass(frozen=True)
+class QFormerConfig:
+    """Shape of the config-gated event Q-Former (``models/qformer.py``).
+
+    The reference declares the module (``use_event_qformer``,
+    ``model/EventChatModel.py:78-81``) but never ships its builder; all
+    dims here are this framework's own design."""
+
+    num_queries: int = 32
+    num_layers: int = 2
+    num_heads: int = 8
+    hidden_size: int = 4096   # = LM embedding dim (queries live in LM space)
+    mlp_ratio: int = 4
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Logical device mesh for pjit sharding (SURVEY.md §2.4).
 
@@ -153,9 +172,19 @@ class EventChatConfig:
     mm_use_im_start_end: bool = False
     mm_use_im_patch_token: bool = True
 
+    # use_event_qformer gate (model/EventChatModel.py:78-81): the reference
+    # declares this path but never ships the builder (SURVEY.md §2.1 P6c);
+    # models/qformer.py supplies the TPU-native design. When enabled, the
+    # Q-Former's learned queries replace the spatio-temporal pool as the
+    # LM's event tokens.
+    use_event_qformer: bool = False
+    qformer: QFormerConfig = field(default_factory=QFormerConfig)
+
     @property
     def num_event_tokens(self) -> int:
         """Tokens contributed by one event clip after the encode stage."""
+        if self.use_event_qformer:
+            return self.qformer.num_queries
         if not self.use_spatio_temporal_pool:
             return self.num_event_frames * self.vision.num_tokens
         t = self.num_temporal_tokens if self.num_temporal_tokens is not None else self.num_event_frames
@@ -194,7 +223,8 @@ def to_dict(cfg: Any) -> Any:
     return cfg
 
 
-_NESTED = {"vision": VisionConfig, "llama": LlamaConfig, "projector": ProjectorConfig}
+_NESTED = {"vision": VisionConfig, "llama": LlamaConfig, "projector": ProjectorConfig,
+           "qformer": QFormerConfig}
 
 
 def event_chat_config_from_dict(data: dict) -> EventChatConfig:
@@ -271,11 +301,20 @@ def from_hf_config(hf: dict, attn_impl: Optional[str] = None) -> EventChatConfig
         output_dim=llama.hidden_size,
         use_feature_adaptor="event_feature_adaptor" in hf,
     )
+    # Value-respecting gate: a parsed config.json dict contains explicit
+    # false values (unlike the reference's hasattr check on a config object,
+    # model/EventChatModel.py:77), so presence alone must not enable it.
+    qf_kwargs = {}
+    if isinstance(hf.get("qformer_config"), dict):
+        known_qf = {f.name for f in dataclasses.fields(QFormerConfig)}
+        qf_kwargs = {k: v for k, v in hf["qformer_config"].items() if k in known_qf}
     return EventChatConfig(
         vision=vision,
         llama=llama,
         projector=proj,
         use_spatio_temporal_pool=hf.get("spatial_temporal_encoder", True),
+        use_event_qformer=bool(hf.get("use_event_qformer", False)),
+        qformer=QFormerConfig(hidden_size=llama.hidden_size, **{k: v for k, v in qf_kwargs.items() if k != "hidden_size"}),
         mm_use_im_start_end=hf.get("mm_use_im_start_end", False),
         mm_use_im_patch_token=hf.get("mm_use_im_patch_token", True),
     )

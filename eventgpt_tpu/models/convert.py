@@ -142,6 +142,11 @@ def projector_params_from_hf(sd: StateDict, mlp_depth: int = 2,
 
 def eventchat_params_from_hf(sd: StateDict, cfg: EventChatConfig) -> Params:
     """Full EventChat_llama state dict -> {clip, projector, llama} pytree."""
+    # A qformer-gated config converts its base model normally; Q-Former
+    # weights never live inside released LM state dicts (the reference loads
+    # them through per-component torch.load hooks, model/EventChatModel.py:
+    # 141-163) — callers init/load them separately (cli/infer.py,
+    # models/qformer.py:load_qformer_components).
     return {
         "clip": clip_params_from_hf(
             sd, cfg.vision, prefix="model.visual_tower.visual_tower.vision_model."

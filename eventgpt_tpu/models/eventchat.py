@@ -38,12 +38,17 @@ Params = Dict[str, Any]
 
 
 def init_eventchat_params(cfg: EventChatConfig, key: jax.Array, dtype=jnp.float32) -> Params:
-    k1, k2, k3 = jax.random.split(key, 3)
-    return {
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    params = {
         "clip": clip_mod.init_clip_params(cfg.vision, k1, dtype),
         "projector": proj_mod.init_projector_params(cfg.projector, k2, dtype),
         "llama": llama_mod.init_llama_params(cfg.llama, k3, dtype),
     }
+    if cfg.use_event_qformer:
+        from eventgpt_tpu.models import qformer as qformer_mod
+
+        params["qformer"] = qformer_mod.init_qformer_params(cfg.qformer, k4, dtype)
+    return params
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -60,6 +65,13 @@ def encode_events(params: Params, cfg: EventChatConfig, pixel_values: jnp.ndarra
     feats = jax.lax.stop_gradient(feats)
     feats = proj_mod.apply_projector(params["projector"], feats)
     feats = proj_mod.apply_adaptor(params["projector"], feats)
+    if cfg.use_event_qformer:
+        # Config-gated Q-Former path (use_event_qformer, model/
+        # EventChatModel.py:78-81): learned queries aggregate the projected
+        # frames into cfg.qformer.num_queries LM tokens.
+        from eventgpt_tpu.models import qformer as qformer_mod
+
+        return qformer_mod.qformer_encode(params["qformer"], cfg.qformer, feats)
     if not cfg.use_spatio_temporal_pool:
         # spatial_temporal_encoder=False path: raw per-frame patch tokens,
         # frames concatenated along the token axis.

@@ -109,12 +109,42 @@ def projector_param_specs(use_feature_adaptor: bool = True, mlp_depth: int = 2) 
     return specs
 
 
-def eventchat_param_specs(use_feature_adaptor: bool = True, mlp_depth: int = 2) -> Specs:
+def qformer_param_specs() -> Specs:
+    """Q-Former (models/qformer.py): stacked (L, D, D) cross-attention +
+    MLP weights — shard the contraction rows over fsdp like the projector;
+    queries and norms replicate."""
     return {
+        "query_embeddings": P(None, None),
+        "attention_layers": {
+            "ln_q": {"scale": P(None, None), "bias": P(None, None)},
+            "ln_kv": {"scale": P(None, None), "bias": P(None, None)},
+            "attn": {
+                "q": P(None, "fsdp", None),
+                "k": P(None, "fsdp", None),
+                "v": P(None, "fsdp", None),
+                "o": P(None, "fsdp", None),
+            },
+            "ln_mlp": {"scale": P(None, None), "bias": P(None, None)},
+            "mlp": {
+                "fc1": P(None, "fsdp", None),
+                "fc1_bias": P(None, None),
+                "fc2": P(None, "fsdp", None),
+                "fc2_bias": P(None, None),
+            },
+        },
+    }
+
+
+def eventchat_param_specs(use_feature_adaptor: bool = True, mlp_depth: int = 2,
+                          use_qformer: bool = False) -> Specs:
+    specs = {
         "clip": clip_param_specs(),
         "projector": projector_param_specs(use_feature_adaptor, mlp_depth),
         "llama": llama_param_specs(),
     }
+    if use_qformer:
+        specs["qformer"] = qformer_param_specs()
+    return specs
 
 
 def kv_cache_specs() -> Specs:

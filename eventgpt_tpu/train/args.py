@@ -22,6 +22,13 @@ class ModelArguments:
     vision_tower: Optional[str] = None
     mm_vision_select_layer: int = -1
     pretrain_mm_mlp_adapter: Optional[str] = None
+    # Q-Former + adaptor pretrain hooks (initialize_vision_modules surface,
+    # model/EventChatModel.py:117-163): component npz artifacts with the
+    # reference's key prefixes.
+    use_event_qformer: bool = False
+    pretrain_feature_adaptor: Optional[str] = None
+    pretrain_query_embedder: Optional[str] = None
+    pretrain_attention_layers: Optional[str] = None
     mm_projector_type: str = "linear"
     mm_use_im_start_end: bool = False
     mm_use_im_patch_token: bool = True

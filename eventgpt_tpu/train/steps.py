@@ -84,20 +84,26 @@ class TrainState(NamedTuple):
 
 
 def stage1_combine(trainable: Params, frozen: Params) -> Params:
-    """Trainable = {"projector"}; CLIP + LM frozen."""
-    return {"clip": frozen["clip"], "llama": frozen["llama"],
-            "projector": trainable["projector"]}
+    """Trainable = {"projector" [, "qformer"]}; CLIP + LM frozen."""
+    out = {"clip": frozen["clip"], "llama": frozen["llama"],
+           "projector": trainable["projector"]}
+    if "qformer" in trainable:
+        out["qformer"] = trainable["qformer"]
+    return out
 
 
 def make_stage2_combine(lora_cfg: LoraConfig) -> Callable[[Params, Params], Params]:
     """Trainable = {"projector", "lora"}; base LM enters as constants."""
 
     def combine(trainable: Params, frozen: Params) -> Params:
-        return {
+        out = {
             "clip": frozen["clip"],
             "projector": trainable["projector"],
             "llama": apply_lora(frozen["llama"], trainable["lora"], lora_cfg),
         }
+        if "qformer" in trainable:
+            out["qformer"] = trainable["qformer"]
+        return out
 
     return combine
 
@@ -168,9 +174,15 @@ def init_train_state(
 
 
 def split_stage1(params: Params) -> Tuple[Params, Params]:
-    """Full param tree -> (trainable, frozen) for stage 1."""
-    return ({"projector": params["projector"]},
-            {"clip": params["clip"], "llama": params["llama"]})
+    """Full param tree -> (trainable, frozen) for stage 1.
+
+    The Q-Former (when the config gates it in) trains alongside the
+    projector — it sits on the same gradient path between the frozen CLIP
+    tower and the frozen LM."""
+    trainable = {"projector": params["projector"]}
+    if "qformer" in params:
+        trainable["qformer"] = params["qformer"]
+    return trainable, {"clip": params["clip"], "llama": params["llama"]}
 
 
 def split_stage2(
@@ -184,6 +196,8 @@ def split_stage2(
         "projector": params["projector"],
         "lora": init_lora_params(cfg.llama, lora_cfg, key, dtype),
     }
+    if "qformer" in params:
+        trainable["qformer"] = params["qformer"]
     frozen = {"clip": params["clip"], "llama": params["llama"]}
     return trainable, frozen
 

@@ -143,19 +143,28 @@ class Trainer:
                 )
             trainable_specs = {"projector": proj_specs,
                                "lora": lora_param_specs(self.lora_cfg.targets)}
+            if "qformer" in trainable:
+                from eventgpt_tpu.parallel.sharding import qformer_param_specs
+
+                trainable_specs["qformer"] = qformer_param_specs()
             if train_args.freeze_mm_mlp_adapter:
                 # Projector stays frozen during stage 2 (freeze_mm_mlp_adapter,
                 # SURVEY.md §2.2): move it to the frozen tree.
                 frozen = {**frozen, "projector": trainable.pop("projector")}
                 frozen_specs = {**frozen_specs, "projector": proj_specs}
-                trainable_specs = {"lora": trainable_specs["lora"]}
+                trainable_specs = {
+                    k: v for k, v in trainable_specs.items() if k != "projector"
+                }
                 lcfg = self.lora_cfg
 
                 def combine(tr, fz, _lcfg=lcfg):
                     from eventgpt_tpu.train.lora import apply_lora
 
-                    return {"clip": fz["clip"], "projector": fz["projector"],
-                            "llama": apply_lora(fz["llama"], tr["lora"], _lcfg)}
+                    out = {"clip": fz["clip"], "projector": fz["projector"],
+                           "llama": apply_lora(fz["llama"], tr["lora"], _lcfg)}
+                    if "qformer" in tr:
+                        out["qformer"] = tr["qformer"]
+                    return out
 
                 self.combine = combine
             else:
@@ -168,6 +177,10 @@ class Trainer:
                 )
             trainable, frozen = steps_mod.split_stage1(params)
             trainable_specs = {"projector": proj_specs}
+            if "qformer" in trainable:
+                from eventgpt_tpu.parallel.sharding import qformer_param_specs
+
+                trainable_specs["qformer"] = qformer_param_specs()
             self.combine = steps_mod.stage1_combine
 
         # Master trainables f32; frozen tree in the compute dtype; the
@@ -260,6 +273,15 @@ class Trainer:
                     os.path.join(self.targs.output_dir, f"lora_{tag}.npz"),
                     jax.device_get(self.state.trainable["lora"]),
                     prefix="lora.",
+                )
+            if "qformer" in self.state.trainable:
+                from eventgpt_tpu.models.qformer import save_qformer_components
+
+                save_qformer_components(
+                    jax.device_get(self.state.trainable["qformer"]),
+                    os.path.join(self.targs.output_dir, f"query_embedder_{tag}.npz"),
+                    os.path.join(self.targs.output_dir, f"attention_layers_{tag}.npz"),
+                    num_heads=self.cfg.qformer.num_heads,
                 )
         return out
 

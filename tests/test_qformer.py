@@ -1,0 +1,194 @@
+"""Event Q-Former (models/qformer.py): the reference's config-gated
+use_event_qformer surface (model/EventChatModel.py:78-81, builder absent)
+realized natively — forward shapes, config gating, end-to-end generate,
+training integration, and the reference-convention component load hooks."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgpt_tpu.config import EventChatConfig, QFormerConfig
+from eventgpt_tpu.models import eventchat, qformer as qf
+
+SAMPLE_DIR = "/root/reference/samples"
+
+
+def tiny_qcfg():
+    return QFormerConfig(num_queries=6, num_layers=2, num_heads=2,
+                         hidden_size=64, mlp_ratio=2)
+
+
+def tiny_cfg_with_qformer():
+    import dataclasses
+
+    cfg = EventChatConfig.tiny()
+    return dataclasses.replace(cfg, use_event_qformer=True, qformer=tiny_qcfg())
+
+
+def test_qformer_encode_shapes_and_finite():
+    qcfg = tiny_qcfg()
+    params = qf.init_qformer_params(qcfg, jax.random.PRNGKey(0))
+    feats = jax.random.normal(jax.random.PRNGKey(1), (5, 9, 64), jnp.float32)
+    out = qf.qformer_encode(params, qcfg, feats)
+    assert out.shape == (6, 64)
+    assert np.isfinite(np.asarray(out)).all()
+    # Flattened input form gives the same result.
+    out2 = qf.qformer_encode(params, qcfg, feats.reshape(-1, 64))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), rtol=1e-6)
+
+
+def test_config_gate_changes_token_budget():
+    base = EventChatConfig.tiny()
+    gated = tiny_cfg_with_qformer()
+    assert not base.use_event_qformer
+    assert base.num_event_tokens != gated.num_event_tokens
+    assert gated.num_event_tokens == 6
+    # Params tree gains the qformer subtree only when gated.
+    p0 = eventchat.init_eventchat_params(base, jax.random.PRNGKey(0))
+    p1 = eventchat.init_eventchat_params(gated, jax.random.PRNGKey(0))
+    assert "qformer" not in p0 and "qformer" in p1
+
+
+def test_encode_events_routes_through_qformer():
+    cfg = tiny_cfg_with_qformer()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(2))
+    pv = jnp.zeros((cfg.num_event_frames, 3, cfg.vision.image_size,
+                    cfg.vision.image_size), jnp.float32)
+    tokens = eventchat.encode_events(params, cfg, pv)
+    assert tokens.shape == (cfg.qformer.num_queries, cfg.llama.hidden_size)
+
+
+def test_generate_end_to_end_with_qformer():
+    cfg = tiny_cfg_with_qformer()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(3))
+    pv = jnp.zeros((1, cfg.num_event_frames, 3, cfg.vision.image_size,
+                    cfg.vision.image_size), jnp.float32)
+    ids = [1, 5, -200, 9, 9, 12]
+    out = eventchat.generate(params, cfg, [ids], pv, max_new_tokens=6,
+                             temperature=0.0, eos_token_id=2)[0]
+    assert 1 <= len(out) <= 6
+    assert all(0 <= t < cfg.llama.vocab_size for t in out)
+
+
+def test_component_save_load_roundtrip(tmp_path):
+    qcfg = tiny_qcfg()
+    params = qf.init_qformer_params(qcfg, jax.random.PRNGKey(4))
+    qp = str(tmp_path / "query_embedder.npz")
+    ap = str(tmp_path / "attention_layers.npz")
+    qf.save_qformer_components(jax.device_get(params), qp, ap)
+
+    # Reference key conventions on disk.
+    qdata = np.load(qp)
+    assert qdata.files == ["model.query_embedder.weight"]
+    adata = np.load(ap)
+    weight_keys = [k for k in adata.files if not k.startswith("qformer_meta.")]
+    assert all(k.startswith("model.attention_layers.") for k in weight_keys)
+    assert any(k.startswith("model.attention_layers.1.") for k in weight_keys)
+
+    fresh = qf.init_qformer_params(qcfg, jax.random.PRNGKey(5))
+    restored = qf.load_qformer_components(fresh, qp, ap)
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_component_load_rejects_wrong_artifacts(tmp_path):
+    qcfg = tiny_qcfg()
+    params = qf.init_qformer_params(qcfg, jax.random.PRNGKey(6))
+    bad = str(tmp_path / "bad.npz")
+    np.savez(bad, **{"unrelated.weight": np.zeros((2, 2))})
+    with pytest.raises(ValueError):
+        qf.load_qformer_components(params, attention_layers_path=bad)
+    with pytest.raises(ValueError):
+        qf.load_qformer_components(params, query_embedder_path=bad)
+
+
+def test_stage1_trains_qformer(tmp_path):
+    """Stage 1 with the gate on: qformer is trainable, its artifact files are
+    written, and training completes with finite loss."""
+    if not os.path.exists(os.path.join(SAMPLE_DIR, "sample1.npy")):
+        pytest.skip("reference sample not available")
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.train.args import (
+        DataArguments, ModelArguments, TrainingArguments,
+    )
+    from eventgpt_tpu.train.trainer import Trainer
+
+    entries = [
+        {"id": i, "event": "sample1.npy",
+         "conversations": [
+             {"from": "human", "value": "<event>\nDescribe."},
+             {"from": "gpt", "value": f"A {i}."}]}
+        for i in range(4)
+    ]
+    data_path = tmp_path / "qa.json"
+    data_path.write_text(json.dumps(entries))
+
+    cfg = tiny_cfg_with_qformer()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    targs = TrainingArguments(
+        output_dir=str(tmp_path / "out"), stage=1, max_steps=2,
+        per_device_train_batch_size=2, logging_steps=1, save_steps=-1,
+        bf16=False, learning_rate=1e-2, mesh_data=1, mesh_fsdp=2,
+    )
+    tr = Trainer(cfg, params, load_tokenizer("byte"), ModelArguments(),
+                 DataArguments(data_path=str(data_path), event_folder=SAMPLE_DIR),
+                 targs)
+    assert "qformer" in tr.state.trainable
+    before = np.asarray(
+        jax.device_get(tr.state.trainable["qformer"]["query_embeddings"])
+    ).copy()
+    metrics = tr.train()
+    assert np.isfinite(metrics["loss"])
+    after = np.asarray(
+        jax.device_get(tr.state.trainable["qformer"]["query_embeddings"])
+    )
+    assert not np.allclose(before, after)  # gradients reached the queries
+    assert os.path.exists(os.path.join(targs.output_dir, "query_embedder_last.npz"))
+    assert os.path.exists(os.path.join(targs.output_dir, "attention_layers_last.npz"))
+
+
+def test_config_from_artifacts_recovers_dims(tmp_path):
+    """Serving must reconstruct the exact training config — including
+    num_heads, which square projections cannot reveal (stored as artifact
+    metadata)."""
+    qcfg = QFormerConfig(num_queries=6, num_layers=3, num_heads=2,
+                         hidden_size=64, mlp_ratio=2)
+    params = qf.init_qformer_params(qcfg, jax.random.PRNGKey(8))
+    qp = str(tmp_path / "q.npz")
+    ap = str(tmp_path / "a.npz")
+    qf.save_qformer_components(jax.device_get(params), qp, ap,
+                               num_heads=qcfg.num_heads)
+    got = qf.qformer_config_from_artifacts(qp, ap)
+    assert got == qcfg
+
+
+def test_infer_cli_serves_trained_qformer(tmp_path):
+    """Serving path: train-written component artifacts load through the
+    infer CLI flags and decode runs end-to-end."""
+    if not os.path.exists(os.path.join(SAMPLE_DIR, "sample1.npy")):
+        pytest.skip("reference sample not available")
+    from eventgpt_tpu.cli import infer as infer_cli
+
+    qcfg = QFormerConfig(num_queries=6, num_layers=2, num_heads=2,
+                         hidden_size=64, mlp_ratio=2)
+    params = qf.init_qformer_params(qcfg, jax.random.PRNGKey(7))
+    qp = str(tmp_path / "query_embedder_last.npz")
+    ap = str(tmp_path / "attention_layers_last.npz")
+    qf.save_qformer_components(jax.device_get(params), qp, ap,
+                               num_heads=qcfg.num_heads)
+
+    out = infer_cli.main([
+        "--model_path", "tiny-random",
+        "--event_frame", os.path.join(SAMPLE_DIR, "sample1.npy"),
+        "--query", "What is happening?",
+        "--temperature", "0", "--max_new_tokens", "4",
+        "--use_event_qformer",
+        "--pretrain_query_embedder", qp,
+        "--pretrain_attention_layers", ap,
+    ])
+    assert isinstance(out, str)
