@@ -138,9 +138,12 @@ def run_decode(args) -> None:
     preset = args.preset
     if preset == "auto":
         preset = "7b" if platform == "tpu" else "tiny"
-    cfg = EventChatConfig.eventgpt_7b() if preset == "7b" else EventChatConfig.tiny()
+    cfg = {"7b": EventChatConfig.eventgpt_7b,
+           "13b": EventChatConfig.eventgpt_13b,
+           "tiny": EventChatConfig.tiny}[preset]()
     dtype = jnp.bfloat16
-    params = _build_params(cfg, dtype, args.quant if preset == "7b" else "bf16",
+    params = _build_params(cfg, dtype,
+                           args.quant if preset in ("7b", "13b") else "bf16",
                            fuse=args.fuse)
 
     pixels = jnp.asarray(_event_pixels(cfg, 1), dtype)
@@ -201,7 +204,7 @@ def run_decode(args) -> None:
     tok_s, t_prefill, t_prefill_first = measure(args.batch)
 
     extras = {
-        "quant": args.quant if preset == "7b" else "bf16",
+        "quant": args.quant if preset in ("7b", "13b") else "bf16",
         "kv_cache": args.kv,
         "batch": args.batch,
         "decode_tokens": args.decode_tokens,
@@ -252,14 +255,16 @@ def run_train(args) -> None:
     preset = args.preset
     if preset == "auto":
         preset = "7b" if platform == "tpu" else "tiny"
-    cfg = EventChatConfig.eventgpt_7b() if preset == "7b" else EventChatConfig.tiny()
+    cfg = {"7b": EventChatConfig.eventgpt_7b,
+           "13b": EventChatConfig.eventgpt_13b,
+           "tiny": EventChatConfig.tiny}[preset]()
     dtype = jnp.bfloat16
 
     # QLoRA-style stage 2 by default at 7B: int8 frozen base + apply-form
     # LoRA keeps the whole train step inside one v5e chip's HBM (bf16 base
     # measures 18.6G > 15.75G); mirrors the reference's bits/nf4 quantized
     # finetune options (TrainingArguments, SURVEY.md §2.2).
-    quant = args.quant if preset == "7b" else "bf16"
+    quant = args.quant if preset in ("7b", "13b") else "bf16"
     params = _build_params(cfg, dtype, quant)
     lcfg = LoraConfig(r=args.lora_r)
     trainable, frozen = steps_mod.split_stage2(
@@ -311,7 +316,7 @@ def run_train(args) -> None:
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--mode", default="decode", choices=["decode", "train"])
-    p.add_argument("--preset", default="auto", choices=["auto", "7b", "tiny"])
+    p.add_argument("--preset", default="auto", choices=["auto", "7b", "13b", "tiny"])
     p.add_argument("--decode_tokens", type=int, default=64)
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--quant", default="int8", choices=["int8", "int4", "bf16"])

@@ -125,16 +125,20 @@ def place_params(tree, jdt):
     return jnp.asarray(tree, jdt)
 
 
-def main(argv=None) -> str:
-    args = build_parser().parse_args(argv)
-    if args.num_beams < 1:
-        raise ValueError(f"num_beams must be >= 1, got {args.num_beams}")
+def prepare_model(cfg, params, tokenizer, args):
+    """Shared post-load preparation for the infer/eval CLIs: optional
+    spatio-temporal / Q-Former config gating, special-token registration
+    (parity with inference.py:33-39), embedding resize, host-side
+    quantization, device placement. Order is load-bearing: the resize must
+    precede quantization (quantized leaves are {"q","s"} dicts that
+    resize_token_embeddings cannot grow), and quantization runs on host so
+    HBM never holds the bf16 and quantized trees together.
 
-    t0 = time.perf_counter()
-    cfg, params, tokenizer = load_model(
-        args.model_path, args.dtype, args.attn_impl, args.tokenizer_path
-    )
-    if args.spatial_temporal_encoder != cfg.use_spatio_temporal_pool:
+    Returns (cfg, params) with params device-placed.
+    """
+    if getattr(args, "spatial_temporal_encoder", None) is not None and (
+        args.spatial_temporal_encoder != cfg.use_spatio_temporal_pool
+    ):
         import dataclasses
 
         cfg = dataclasses.replace(cfg, use_spatio_temporal_pool=args.spatial_temporal_encoder)
@@ -166,7 +170,6 @@ def main(argv=None) -> str:
                 attention_layers_path=args.pretrain_attention_layers,
             )
 
-    # Special-token registration parity with inference.py:33-39.
     if cfg.mm_use_im_patch_token:
         tokenizer.add_tokens([constants.DEFAULT_EVENT_PATCH_TOKEN], special_tokens=True)
     if cfg.mm_use_im_start_end:
@@ -177,9 +180,6 @@ def main(argv=None) -> str:
     if len(tokenizer) > cfg.llama.vocab_size:
         params["llama"] = resize_token_embeddings(params["llama"], len(tokenizer))
     if args.quant in ("int8", "int4"):
-        # After embedding resize — quantized leaves are {"q","s"} dicts that
-        # resize_token_embeddings cannot grow. Host-side: never holds the
-        # bf16 and quantized trees in HBM together.
         from eventgpt_tpu.ops.quant import quantize_llama_params
 
         params["llama"] = quantize_llama_params(
@@ -189,6 +189,19 @@ def main(argv=None) -> str:
     import jax.numpy as jnp
 
     params = place_params(params, jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32)
+    return cfg, params
+
+
+def main(argv=None) -> str:
+    args = build_parser().parse_args(argv)
+    if args.num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {args.num_beams}")
+
+    t0 = time.perf_counter()
+    cfg, params, tokenizer = load_model(
+        args.model_path, args.dtype, args.attn_impl, args.tokenizer_path
+    )
+    cfg, params = prepare_model(cfg, params, tokenizer, args)
     t_load = time.perf_counter() - t0
 
     t0 = time.perf_counter()

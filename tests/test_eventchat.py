@@ -180,3 +180,48 @@ def test_init_params_shapes():
     assert params["projector"]["mlp"][0]["kernel"].shape == (
         CFG.projector.input_dim, CFG.projector.output_dim,
     )
+
+
+def test_eval_cli_batched_samples(tmp_path):
+    """BASELINE config 2: batched inference across event samples through one
+    generate call, with the transcript-comparison gate."""
+    import json as _json
+    import os as _os
+
+    import pytest as _pytest
+
+    sample = "/root/reference/samples/sample1.npy"
+    if not _os.path.exists(sample):
+        _pytest.skip("reference sample not available")
+    from eventgpt_tpu.cli import eval as eval_cli
+
+    answers = eval_cli.main([
+        "--model_path", "tiny-random",
+        "--event_frames", f"{sample},{sample}",
+        "--query", "What is happening?",
+        "--temperature", "0", "--max_new_tokens", "4",
+    ])
+    assert len(answers) == 2
+    # Greedy + identical inputs -> identical answers across the batch.
+    assert answers[0] == answers[1]
+
+    # Transcript gate: matching expectations pass...
+    exp = tmp_path / "expected.json"
+    exp.write_text(_json.dumps(answers))
+    eval_cli.main([
+        "--model_path", "tiny-random",
+        "--event_frames", f"{sample},{sample}",
+        "--query", "What is happening?",
+        "--temperature", "0", "--max_new_tokens", "4",
+        "--expected", str(exp),
+    ])
+    # ...mismatches exit nonzero.
+    exp.write_text(_json.dumps(["definitely wrong", "also wrong"]))
+    with _pytest.raises(SystemExit):
+        eval_cli.main([
+            "--model_path", "tiny-random",
+            "--event_frames", f"{sample},{sample}",
+            "--query", "What is happening?",
+            "--temperature", "0", "--max_new_tokens", "4",
+            "--expected", str(exp),
+        ])
