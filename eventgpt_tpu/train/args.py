@@ -79,6 +79,9 @@ class TrainingArguments:
     # with a reshuffled batch order, at most max_divergence_rewinds times.
     on_divergence: str = "raise"
     max_divergence_rewinds: int = 2
+    # Host batches prepared ahead of the device (train/prefetch.py);
+    # 0 disables the producer thread.
+    prefetch_depth: int = 2
     # Multi-host preemption agreement cadence (micro-batches): the shutdown
     # flag needs a cross-host allgather so every host checkpoints at the same
     # boundary, but doing that every micro-batch would fence async dispatch —

@@ -34,6 +34,7 @@ from eventgpt_tpu.train.args import DataArguments, ModelArguments, TrainingArgum
 from eventgpt_tpu.train.data import EventChatDataset, batch_iterator
 from eventgpt_tpu.train.lora import LoraConfig, lora_param_specs
 from eventgpt_tpu.train.optim import linear_warmup_cosine, make_optimizer
+from eventgpt_tpu.train.prefetch import PrefetchIterator
 from eventgpt_tpu.train.resilience import GracefulShutdown, Heartbeat
 
 log = logging.getLogger("eventgpt_tpu.train")
@@ -377,96 +378,108 @@ class Trainer:
                 group_by_modality_length=targs.group_by_modality_length,
                 max_len=targs.model_max_length,
             )
+            if targs.prefetch_depth > 0:
+                # Overlap host preprocessing (np.load + rasterize + CLIP
+                # resize) with the device step; the finally closes the
+                # producer on every exit path (preempt, divergence, done).
+                it = PrefetchIterator(it, depth=targs.prefetch_depth)
             window: list = []  # (loss, grad_norm) device scalars, one per micro
             t_window = time.perf_counter()
             diverged = False
-            for host_batch in it:
-                # Local flag check is free; the cross-host AGREEMENT collective
-                # (globally_requested) only runs every preempt_poll_micros so
-                # multi-host runs don't fence async dispatch per micro-batch.
-                # All hosts share the micro counter, so they poll (and thus
-                # act) at the same boundary.
-                poll = (jax.process_count() == 1
-                        or micro % max(targs.preempt_poll_micros, 1) == 0)
-                if poll and shutdown.globally_requested():
-                    # Step-numbered name so auto-resume can order it without
-                    # trusting filesystem mtimes (checkpoint.py ordering).
-                    self.save(f"preempt_step{step}")
-                    last_metrics = {**last_metrics, "preempted": True,
-                                    "reason": shutdown.reason, "step": step}
-                    self._log({"event": "preempt", "reason": shutdown.reason,
-                               "step": step})
-                    return last_metrics
-                batch = steps_mod.batch_to_device(host_batch, self.mesh)
-                self.state, metrics = self.train_step(self.state, batch)
-                micro += 1
-                tokens_seen += int(host_batch["attn_mask"].sum())
-                window.append((metrics["loss"], metrics["grad_norm"]))
-                if micro % accum:
-                    continue  # gradients still accumulating
-                step += 1
+            try:
+                for host_batch in it:
+                    # Local flag check is free; the cross-host AGREEMENT collective
+                    # (globally_requested) only runs every preempt_poll_micros so
+                    # multi-host runs don't fence async dispatch per micro-batch.
+                    # All hosts share the micro counter, so they poll (and thus
+                    # act) at the same boundary.
+                    poll = (jax.process_count() == 1
+                            or micro % max(targs.preempt_poll_micros, 1) == 0)
+                    if poll and shutdown.globally_requested():
+                        # Step-numbered name so auto-resume can order it without
+                        # trusting filesystem mtimes (checkpoint.py ordering).
+                        self.save(f"preempt_step{step}")
+                        last_metrics = {**last_metrics, "preempted": True,
+                                        "reason": shutdown.reason, "step": step}
+                        self._log({"event": "preempt", "reason": shutdown.reason,
+                                   "step": step})
+                        return last_metrics
+                    batch = steps_mod.batch_to_device(host_batch, self.mesh)
+                    self.state, metrics = self.train_step(self.state, batch)
+                    micro += 1
+                    tokens_seen += int(host_batch["attn_mask"].sum())
+                    window.append((metrics["loss"], metrics["grad_norm"]))
+                    if micro % accum:
+                        continue  # gradients still accumulating
+                    step += 1
 
-                need_log = step % targs.logging_steps == 0 or step == 1
-                need_save = targs.save_steps > 0 and step % targs.save_steps == 0
-                if need_log or need_save:
-                    # Mean over the accumulation window (HF reports per
-                    # optimizer step, not last-micro-batch noise). Host
-                    # readback only on logging/save steps — an unconditional
-                    # device_get would fence async dispatch every step. Save
-                    # steps read the loss too, so a checkpoint is never
-                    # written from a window that already went non-finite
-                    # (rewind would otherwise reload poisoned state).
-                    loss = float(jax.device_get(sum(w[0] for w in window))) / len(window)
-                    gnorm = float(jax.device_get(sum(w[1] for w in window))) / len(window)
-                    if not math.isfinite(loss):
-                        if (targs.on_divergence == "rewind"
-                                and rewinds < targs.max_divergence_rewinds
-                                and self._last_ckpt):
-                            rewinds += 1
-                            self._log({"event": "divergence_rewind",
-                                       "step": step, "loss": loss,
-                                       "rewind": rewinds,
-                                       "checkpoint": self._last_ckpt})
-                            self.resume(self._last_ckpt)
-                            micro = int(jax.device_get(self.state.step))
-                            step = micro // accum
-                            # Discarded steps' tokens don't count twice in
-                            # tokens_per_s (replay re-counts them).
-                            tokens_seen = ckpt_tokens.get(self._last_ckpt,
-                                                          tokens_seen)
-                            diverged = True
-                            break  # new epoch iterator, reshuffled
-                        raise TrainingDivergedError(
-                            f"non-finite loss {loss} at optimizer step {step}; "
-                            f"restart with --resume_from auto to continue from "
-                            f"the last checkpoint in {targs.output_dir}"
-                        )
-                    if need_log:
-                        dt = time.perf_counter() - t_window
-                        last_metrics = {
-                            "step": step, "epoch": epoch, "loss": loss,
-                            "grad_norm": gnorm,
-                            "step_time_s": round(dt, 4),
-                            "tokens_per_s": round(tokens_seen / (time.perf_counter() - t_start), 1),
-                        }
-                        self._log(last_metrics)
-                window.clear()
-                t_window = time.perf_counter()
-                # Liveness beat on its own time cadence (not logging_steps):
-                # watchdogs need a staleness bound independent of logging
-                # config. Loss rides along only when this step logged one.
-                now = time.perf_counter()
-                if is_primary() and (
-                    need_log or now - last_beat > targs.heartbeat_interval_s
-                ):
-                    self.heartbeat.beat(step, **({"loss": loss} if need_log else {}))
-                    last_beat = now
-                if need_save:
-                    self.save(f"step{step}")
-                    ckpt_tokens[self._last_ckpt] = tokens_seen
-                if 0 < targs.max_steps <= step:
-                    done = True
-                    break
+                    need_log = step % targs.logging_steps == 0 or step == 1
+                    need_save = targs.save_steps > 0 and step % targs.save_steps == 0
+                    if need_log or need_save:
+                        # Mean over the accumulation window (HF reports per
+                        # optimizer step, not last-micro-batch noise). Host
+                        # readback only on logging/save steps — an unconditional
+                        # device_get would fence async dispatch every step. Save
+                        # steps read the loss too, so a checkpoint is never
+                        # written from a window that already went non-finite
+                        # (rewind would otherwise reload poisoned state).
+                        loss = float(jax.device_get(sum(w[0] for w in window))) / len(window)
+                        gnorm = float(jax.device_get(sum(w[1] for w in window))) / len(window)
+                        if not math.isfinite(loss):
+                            if (targs.on_divergence == "rewind"
+                                    and rewinds < targs.max_divergence_rewinds
+                                    and self._last_ckpt):
+                                rewinds += 1
+                                self._log({"event": "divergence_rewind",
+                                           "step": step, "loss": loss,
+                                           "rewind": rewinds,
+                                           "checkpoint": self._last_ckpt})
+                                self.resume(self._last_ckpt)
+                                micro = int(jax.device_get(self.state.step))
+                                step = micro // accum
+                                # Discarded steps' tokens don't count twice in
+                                # tokens_per_s (replay re-counts them).
+                                tokens_seen = ckpt_tokens.get(self._last_ckpt,
+                                                              tokens_seen)
+                                diverged = True
+                                break  # new epoch iterator, reshuffled
+                            raise TrainingDivergedError(
+                                f"non-finite loss {loss} at optimizer step {step}; "
+                                f"restart with --resume_from auto to continue from "
+                                f"the last checkpoint in {targs.output_dir}"
+                            )
+                        if need_log:
+                            dt = time.perf_counter() - t_window
+                            last_metrics = {
+                                "step": step, "epoch": epoch, "loss": loss,
+                                "grad_norm": gnorm,
+                                "step_time_s": round(dt, 4),
+                                "tokens_per_s": round(tokens_seen / (time.perf_counter() - t_start), 1),
+                            }
+                            self._log(last_metrics)
+                    window.clear()
+                    t_window = time.perf_counter()
+                    # Liveness beat on its own time cadence (not logging_steps):
+                    # watchdogs need a staleness bound independent of logging
+                    # config. Loss rides along only when this step logged one.
+                    now = time.perf_counter()
+                    if is_primary() and (
+                        need_log or now - last_beat > targs.heartbeat_interval_s
+                    ):
+                        self.heartbeat.beat(step, **({"loss": loss} if need_log else {}))
+                        last_beat = now
+                    if need_save:
+                        self.save(f"step{step}")
+                        ckpt_tokens[self._last_ckpt] = tokens_seen
+                    if 0 < targs.max_steps <= step:
+                        done = True
+                        break
+            finally:
+                # Stop the producer thread on every exit path (normal
+                # exhaustion, preempt return, divergence/done break,
+                # exception) — a blocked put() must not leak per epoch.
+                if isinstance(it, PrefetchIterator):
+                    it.close()
             if diverged:
                 # Replay the epoch range from the restored step; the epoch
                 # counter stays (rewinds bump the shuffle seed instead).

@@ -1,0 +1,81 @@
+"""PrefetchIterator (train/prefetch.py): ordering, overlap, error
+propagation, and shutdown — the DataLoader-worker replacement the
+synchronous batch_iterator lacked."""
+
+import threading
+import time
+
+import pytest
+
+from eventgpt_tpu.train.prefetch import PrefetchIterator
+
+
+def test_ordering_preserved():
+    with PrefetchIterator(iter(range(100)), depth=4) as it:
+        assert list(it) == list(range(100))
+
+
+def test_producer_runs_ahead():
+    produced = []
+
+    def slow_consumer_source():
+        for i in range(10):
+            produced.append(i)
+            yield i
+
+    with PrefetchIterator(slow_consumer_source(), depth=3) as it:
+        first = next(it)
+        assert first == 0
+        # Give the producer time to fill the queue while we hold one item.
+        deadline = time.time() + 5
+        while len(produced) < 4 and time.time() < deadline:
+            time.sleep(0.01)
+        # depth=3 queued + 1 consumed -> at least 4 produced before we ask.
+        assert len(produced) >= 4
+
+
+def test_exception_propagates_original_type():
+    """The trainer must see the same exception with prefetch on or off."""
+
+    def bad_source():
+        yield 1
+        raise ValueError("poisoned batch")
+
+    with PrefetchIterator(bad_source(), depth=2) as it:
+        assert next(it) == 1
+        with pytest.raises(ValueError, match="poisoned batch"):
+            next(it)
+
+
+def test_close_unblocks_full_queue_and_joins_thread():
+    def endless():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    it = PrefetchIterator(endless(), depth=1)
+    assert next(it) == 0
+    it.close()
+    assert not it._thread.is_alive()
+    # Closed iterator terminates cleanly.
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_invalid_depth_rejected():
+    with pytest.raises(ValueError, match="depth"):
+        PrefetchIterator(iter([]), depth=0)
+
+
+def test_early_break_then_new_epoch():
+    """The trainer breaks out mid-epoch (divergence/done) and builds a new
+    iterator next epoch; closed producers must not leak threads."""
+    before = threading.active_count()
+    for _ in range(5):
+        with PrefetchIterator(iter(range(50)), depth=2) as it:
+            for j, x in enumerate(it):
+                if j == 3:
+                    break
+    time.sleep(0.2)
+    assert threading.active_count() <= before + 1
