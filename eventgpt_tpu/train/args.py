@@ -38,6 +38,7 @@ class ModelArguments:
 @dataclass
 class DataArguments:
     data_path: str = ""
+    eval_data_path: str = ""            # held-out JSON; enables evaluation
     lazy_preprocess: bool = True
     is_multimodal: bool = True
     event_folder: str = ""
@@ -63,6 +64,9 @@ class TrainingArguments:
     seed: int = 0
     logging_steps: int = 10
     save_steps: int = 500
+    # Evaluate on eval_data_path every N optimizer steps (and at the end);
+    # 0 = only at the end, -1 = never. No-op without an eval dataset.
+    eval_steps: int = 0
     group_by_modality_length: bool = False
     freeze_mm_mlp_adapter: bool = False
     mm_projector_lr: Optional[float] = None

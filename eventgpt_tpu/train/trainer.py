@@ -111,6 +111,17 @@ class Trainer:
             conv_version=data_args.conv_version,
             image_aspect_ratio=data_args.image_aspect_ratio,
         )
+        # Held-out evaluation set (HF Trainer's eval_dataset seat in
+        # make_supervised_data_module, SURVEY.md §2.2 — the reference always
+        # passes None; here it is a real option).
+        self.eval_dataset = None
+        if data_args.eval_data_path:
+            self.eval_dataset = EventChatDataset(
+                data_args.eval_data_path, tokenizer, cfg,
+                event_folder=data_args.event_folder,
+                conv_version=data_args.conv_version,
+                image_aspect_ratio=data_args.image_aspect_ratio,
+            )
 
         # --- stage split + shardings -----------------------------------
         # bf16 applies to the FROZEN tree and the forward compute only;
@@ -233,6 +244,7 @@ class Trainer:
         self.train_step = steps_mod.make_train_step(
             cfg, self.optimizer, self.combine, mesh=mesh
         )
+        self.eval_step = steps_mod.make_eval_step(cfg, self.combine, mesh=mesh)
         self.metrics_path = os.path.join(train_args.output_dir, "metrics.jsonl")
         self.heartbeat = Heartbeat(train_args.output_dir)
         self._last_ckpt: Optional[str] = None
@@ -250,6 +262,48 @@ class Trainer:
         with open(self.metrics_path, "a") as f:
             f.write(json.dumps(record) + "\n")
         log.info("step %s: %s", record.get("step"), record)
+
+    def evaluate(self, step: Optional[int] = None) -> Dict[str, float]:
+        """Mean next-token loss over the held-out set (token-weighted);
+        logs an ``eval_loss`` record and returns it."""
+        if self.eval_dataset is None:
+            raise ValueError("no eval dataset (set --eval_data_path)")
+        from eventgpt_tpu.constants import IGNORE_INDEX
+
+        dp = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        total_loss, total_tokens = 0.0, 0
+        for host_batch in batch_iterator(
+            self.eval_dataset, self.global_batch_size, self.cfg,
+            shuffle=False, drop_last=False,
+            max_len=self.targs.model_max_length,
+        ):
+            b = next(iter(host_batch.values())).shape[0]
+            if b % dp:
+                # Pad the trailing partial batch to the data-parallel extent
+                # with IGNORE-labeled copies: they shard cleanly and
+                # contribute zero tokens to the token-weighted mean.
+                pad = dp - b % dp
+                host_batch = {
+                    k: np.concatenate([v] + [v[:1]] * pad) for k, v in host_batch.items()
+                }
+                host_batch["labels"][b:] = IGNORE_INDEX
+            batch = steps_mod.batch_to_device(host_batch, self.mesh)
+            metrics = self.eval_step(self.state, batch)
+            n = float(jax.device_get(metrics["n_tokens"]))
+            total_loss += float(jax.device_get(metrics["loss"])) * n
+            total_tokens += n
+        if total_tokens == 0:
+            raise ValueError(
+                f"eval dataset {self.dargs.eval_data_path!r} produced zero "
+                f"supervised tokens — empty or fully filtered eval set"
+            )
+        record = {
+            "eval_loss": total_loss / total_tokens,
+            "eval_tokens": int(total_tokens),
+            **({"step": step} if step is not None else {}),
+        }
+        self._log(record)
+        return record
 
     def save(self, tag: str = "last") -> str:
         """Full state checkpoint + the stage-1 style component artifact."""
@@ -352,6 +406,7 @@ class Trainer:
         rewinds = 0
         ckpt_tokens: Dict[str, int] = {}  # tokens_seen at each save point
         last_beat = 0.0
+        last_eval_step = -1
 
         if len(self.dataset) < self.global_batch_size:
             raise ValueError(
@@ -471,6 +526,10 @@ class Trainer:
                     if need_save:
                         self.save(f"step{step}")
                         ckpt_tokens[self._last_ckpt] = tokens_seen
+                    if (self.eval_dataset is not None and targs.eval_steps > 0
+                            and step % targs.eval_steps == 0):
+                        last_metrics = {**last_metrics, **self.evaluate(step)}
+                        last_eval_step = step
                     if 0 < targs.max_steps <= step:
                         done = True
                         break
@@ -484,5 +543,10 @@ class Trainer:
                 # Replay the epoch range from the restored step; the epoch
                 # counter stays (rewinds bump the shuffle seed instead).
                 epoch -= 1
+        if (self.eval_dataset is not None and targs.eval_steps >= 0
+                and last_eval_step != step):
+            # Skip when the in-loop eval already ran at this exact step —
+            # the state is unchanged and a second full pass is pure waste.
+            last_metrics = {**last_metrics, **self.evaluate(step)}
         self.save("last")
         return last_metrics

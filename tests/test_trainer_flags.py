@@ -172,3 +172,75 @@ def test_diverged_loss_raises(toy_data, tmp_path):
     )
     with pytest.raises(TrainingDivergedError, match="resume_from auto"):
         tr.train()
+
+
+def test_eval_loop_during_and_after_training(toy_data, tmp_path):
+    """--eval_data_path enables a held-out eval pass every eval_steps and at
+    the end (HF evaluation semantics); partial final batches pad with
+    IGNORE-labeled rows instead of tripping the dp-divisibility guard."""
+    from eventgpt_tpu.train.args import DataArguments, ModelArguments
+    from eventgpt_tpu.config import EventChatConfig
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.models import eventchat
+
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    targs_kw = dict(
+        output_dir=str(tmp_path / "out"), stage=1, max_steps=2,
+        per_device_train_batch_size=2, logging_steps=1, save_steps=-1,
+        bf16=False, learning_rate=1e-2, mesh_data=1, mesh_fsdp=2,
+        eval_steps=1,
+    )
+    from eventgpt_tpu.train.args import TrainingArguments
+
+    # Eval set of 5 entries: global batch 4 -> one full + one partial batch.
+    eval_path = tmp_path / "eval.json"
+    entries = json.loads(open(toy_data).read())
+    eval_path.write_text(json.dumps(entries + [entries[0]]))
+
+    tr = Trainer(
+        cfg, params, load_tokenizer("byte"), ModelArguments(),
+        DataArguments(data_path=toy_data, event_folder=SAMPLE_DIR,
+                      eval_data_path=str(eval_path)),
+        TrainingArguments(**targs_kw),
+    )
+    metrics = tr.train()
+    assert np.isfinite(metrics["eval_loss"])
+    records = [json.loads(l) for l in open(tr.metrics_path)]
+    evals = [r for r in records if "eval_loss" in r]
+    # eval_steps=1 with 2 optimizer steps -> 2 mid-train evals; the final
+    # eval is skipped because the step-2 eval just ran on the same state.
+    assert len(evals) == 2
+    # 5 entries x (a few supervised tokens each): token count is positive
+    # and identical across evals of the same frozen-eval set sizes.
+    assert evals[0]["eval_tokens"] > 0
+    assert evals[0]["eval_tokens"] == evals[-1]["eval_tokens"]
+
+
+def test_eval_never_and_missing_dataset(toy_data, tmp_path):
+    from eventgpt_tpu.config import EventChatConfig
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.models import eventchat
+    from eventgpt_tpu.train.args import DataArguments, ModelArguments
+
+    tr = _trainer(toy_data, tmp_path, stage=1)
+    with pytest.raises(ValueError, match="eval dataset"):
+        tr.evaluate()
+
+    # eval_steps=-1: an eval dataset is present but evaluation never runs.
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    tr2 = Trainer(
+        cfg, params, load_tokenizer("byte"), ModelArguments(),
+        DataArguments(data_path=toy_data, event_folder=SAMPLE_DIR,
+                      eval_data_path=toy_data),
+        TrainingArguments(
+            output_dir=str(tmp_path / "out2"), stage=1, max_steps=1,
+            per_device_train_batch_size=2, logging_steps=1, save_steps=-1,
+            bf16=False, mesh_data=1, mesh_fsdp=2, eval_steps=-1,
+        ),
+    )
+    metrics = tr2.train()
+    assert "eval_loss" not in metrics
+    records = [json.loads(l) for l in open(tr2.metrics_path)]
+    assert not any("eval_loss" in r for r in records)
