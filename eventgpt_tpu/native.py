@@ -78,3 +78,90 @@ def rasterize_events_native(
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
     )
     return out.reshape(height, width, 3)
+
+
+class EventStream:
+    """Consumer handle over the native threaded event-stream producer
+    (``native/include/egpt/events_io.hpp`` — the EventsDataIO PushData/
+    PopDataUntil seam, EventsDataIO.cpp:53-145, across the C boundary).
+
+    A producer thread replays a txt ("t x y p") or structured-npy file,
+    optionally paced at wall-clock rate; ``pop_until(horizon)`` returns every
+    event with t <= horizon as numpy arrays, splitting a straddling packet
+    exactly like the reference consumer.
+    """
+
+    def __init__(self, path: str, paced: bool = False, pace_factor: float = 1.0):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError(
+                "libegpt_native.so not built; run scripts/build_native.sh"
+            )
+        self._lib = lib
+        lib.egpt_stream_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+        ]
+        lib.egpt_stream_open.restype = ctypes.c_void_p
+        lib.egpt_stream_pop_until.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        lib.egpt_stream_pop_until.restype = ctypes.c_int64
+        lib.egpt_stream_fetch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_uint16),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.egpt_stream_fetch.restype = None
+        lib.egpt_stream_running.argtypes = [ctypes.c_void_p]
+        lib.egpt_stream_running.restype = ctypes.c_int
+        lib.egpt_stream_close.argtypes = [ctypes.c_void_p]
+        lib.egpt_stream_close.restype = None
+
+        is_npy = 1 if path.endswith(".npy") else 0
+        self._handle = lib.egpt_stream_open(
+            path.encode(), is_npy, 1 if paced else 0, float(pace_factor)
+        )
+        if not self._handle:
+            raise FileNotFoundError(f"could not open event stream {path}")
+        # GC safety net: a handle that is never close()d must not leak the
+        # native producer thread/queue for the process lifetime. finalize is
+        # idempotent with close() (detached there).
+        import weakref
+
+        self._finalizer = weakref.finalize(
+            self, lib.egpt_stream_close, self._handle
+        )
+
+    def pop_until(self, horizon_s: float):
+        """Events with t <= horizon (seconds) -> dict of numpy arrays
+        {x: u16, y: u16, t: f64 seconds, p: u8}. Non-blocking."""
+        n = self._lib.egpt_stream_pop_until(self._handle, float(horizon_s))
+        if n < 0:
+            raise RuntimeError("pop on a closed stream")
+        x = np.empty(n, np.uint16)
+        y = np.empty(n, np.uint16)
+        t = np.empty(n, np.float64)
+        p = np.empty(n, np.uint8)
+        if n:
+            self._lib.egpt_stream_fetch(
+                self._handle,
+                x.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                y.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                t.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                p.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            )
+        return {"x": x, "y": y, "t": t, "p": p}
+
+    def running(self) -> bool:
+        """True while the producer thread is alive or events remain queued."""
+        return bool(self._lib.egpt_stream_running(self._handle))
+
+    def close(self) -> None:
+        if self._handle:
+            self._finalizer.detach()
+            self._lib.egpt_stream_close(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "EventStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -48,8 +48,8 @@ class EventsDataIO {
   ~EventsDataIO() { Stop(); }
 
   // Spawn the producer thread reading a whitespace "t x y p" file
-  // (GoOfflineTxt). t in seconds or microseconds (auto-detected: values
-  // > 1e7 are treated as microseconds).
+  // (GoOfflineTxt). t in seconds or microseconds (auto-detected: max value
+  // > 1e5 means microseconds — no real recording spans 1e5 seconds).
   bool GoOfflineTxt(const std::string& path);
 
   // Spawn the producer thread reading a structured npy with fields

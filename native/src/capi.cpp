@@ -74,4 +74,58 @@ int egpt_npy_to_frames(const char* path, int n_frames, int height, int width,
   return 0;
 }
 
+// --- Streaming (EventsDataIO) ---------------------------------------------
+// Two-phase pop: egpt_stream_pop_until stages events <= horizon into the
+// handle and returns the count; egpt_stream_fetch copies them out. Mirrors
+// the consumer side of the reference's PushData/PopDataUntil seam
+// (EventsDataIO.cpp:53-145) across the C boundary.
+
+struct EgptStream {
+  egpt::EventsDataIO io;
+  std::vector<egpt::Event> staged;
+  EgptStream(const egpt::EventsDataIO::Options& o) : io(o) {}
+};
+
+// Open a file-backed stream. is_npy selects the structured-npy reader vs
+// the "t x y p" txt reader; paced != 0 replays at wall-clock rate scaled
+// by pace_factor. Returns nullptr on open failure.
+void* egpt_stream_open(const char* path, int is_npy, int paced,
+                       double pace_factor) {
+  egpt::EventsDataIO::Options opts;
+  opts.paced = paced != 0;
+  opts.pace_factor = pace_factor > 0 ? pace_factor : 1.0;
+  auto* s = new EgptStream(opts);
+  const bool ok = is_npy ? s->io.GoOfflineNpy(path) : s->io.GoOfflineTxt(path);
+  if (!ok) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int64_t egpt_stream_pop_until(void* handle, double horizon) {
+  if (!handle) return -1;
+  auto* s = static_cast<EgptStream*>(handle);
+  s->staged.clear();
+  s->io.PopDataUntil(horizon, s->staged);
+  return static_cast<int64_t>(s->staged.size());
+}
+
+void egpt_stream_fetch(void* handle, uint16_t* x, uint16_t* y, double* t,
+                       uint8_t* p) {
+  auto* s = static_cast<EgptStream*>(handle);
+  for (size_t i = 0; i < s->staged.size(); ++i) {
+    x[i] = s->staged[i].x;
+    y[i] = s->staged[i].y;
+    t[i] = s->staged[i].t;
+    p[i] = s->staged[i].p;
+  }
+}
+
+int egpt_stream_running(void* handle) {
+  return handle && static_cast<EgptStream*>(handle)->io.Running() ? 1 : 0;
+}
+
+void egpt_stream_close(void* handle) { delete static_cast<EgptStream*>(handle); }
+
 }  // extern "C"

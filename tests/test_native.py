@@ -5,6 +5,7 @@ Skipped when libegpt_native.so has not been built
 falls back to the numpy scatter path automatically when absent.
 """
 
+import os
 import subprocess
 import time
 
@@ -120,3 +121,91 @@ def test_native_raster_speedup(sample1_events):
     t_numpy = time.perf_counter() - t0
     # Not a hard perf gate — just catch pathological regressions.
     assert t_native < t_numpy * 1.5, (t_native, t_numpy)
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib not built")
+def test_event_stream_pop_until_semantics(tmp_path):
+    """Streaming consumer over the C boundary: horizon pops return exactly
+    the events with t <= horizon, in order, across packet splits."""
+    from eventgpt_tpu.native import EventStream
+
+    # 3 ms of events at 1 per 100 us -> spans multiple ~1 ms packets.
+    # (t written in seconds; integer values <= 1e5 are auto-detected as
+    # seconds by the txt reader — events_io.cpp's threshold.)
+    lines = [f"{i * 100e-6:.6f} {i % 7} {i % 5} {i % 2}" for i in range(30)]
+    path = tmp_path / "events.txt"
+    path.write_text("\n".join(lines) + "\n")
+
+    with EventStream(str(path)) as stream:
+        deadline = time.time() + 5
+        got_t = []
+        while (stream.running() or len(got_t) < 30) and time.time() < deadline:
+            out = stream.pop_until(0.0015)  # first horizon: t <= 1.5 ms
+            got_t.extend(out["t"].tolist())
+            if got_t:
+                break
+            time.sleep(0.005)
+        # Everything popped so far respects the horizon.
+        assert got_t and max(got_t) <= 0.0015 + 1e-9
+        first_count = len(got_t)
+
+        # Drain the rest with a far horizon.
+        deadline = time.time() + 5
+        while len(got_t) < 30 and time.time() < deadline:
+            out = stream.pop_until(10.0)
+            got_t.extend(out["t"].tolist())
+            time.sleep(0.002)
+        assert len(got_t) == 30
+        assert got_t == sorted(got_t)  # order preserved across splits
+        assert first_count < 30        # the split actually happened
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib not built")
+def test_event_stream_npy_and_missing_file(tmp_path):
+    """Structured-npy streaming (the DSEC-style schema; the reference's
+    pickled sample1.npy needs the Python loader, not the native reader)."""
+    from eventgpt_tpu.native import EventStream
+
+    n = 500
+    arr = np.zeros(n, dtype=[("x", "<u2"), ("y", "<u2"),
+                             ("t", "<u2"), ("p", "u1")])
+    arr["x"] = np.arange(n) % 320
+    arr["y"] = np.arange(n) % 240
+    arr["t"] = np.arange(n) * 100          # microseconds
+    arr["p"] = np.arange(n) % 2
+    path = tmp_path / "events.npy"
+    np.save(path, arr)
+
+    with EventStream(str(path)) as stream:
+        deadline = time.time() + 10
+        total = 0
+        while (stream.running() or total == 0) and time.time() < deadline:
+            total += len(stream.pop_until(1e9)["t"])
+            if total == n and not stream.running():
+                break
+            time.sleep(0.005)
+        assert total == n
+    with pytest.raises(FileNotFoundError):
+        EventStream(str(tmp_path / "missing.txt"))
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib not built")
+def test_stream_demo_end_to_end():
+    """L0->L6 streaming loop: native threaded IO -> windowed rasterize ->
+    model answers, one per 10 ms window of sample1."""
+    if not os.path.exists("/root/reference/samples/sample1.npy"):
+        pytest.skip("reference sample not available")
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "stream_demo",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "scripts", "stream_demo.py"),
+    )
+    demo = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(demo)
+    answered = demo.main([
+        "--model_path", "tiny-random", "--window_ms", "10",
+        "--max_windows", "2", "--max_new_tokens", "2",
+    ])
+    assert answered == 2
