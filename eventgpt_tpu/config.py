@@ -64,8 +64,10 @@ class LlamaConfig:
     max_seq_len: int = 2048  # reference context cap: model/EventChatModel.py:378
     tie_word_embeddings: bool = False
     # "dense" = materialized-scores attention; "flash" = Pallas fused kernel
-    # for prefill (ops/flash_attention.py). Decode always uses the dense
-    # single-query path against the KV cache.
+    # for prefill (ops/flash_attention.py); "ring" / "ulysses" = sequence-
+    # parallel attention over a context>1 mesh (parallel/ring.py,
+    # parallel/ulysses.py). Decode always uses the dense single-query path
+    # against the KV cache.
     attn_impl: str = "dense"
     # Rematerialize each layer in the backward pass (jax.checkpoint around
     # the scan body). Identity for forward-only jit; under grad it stops AD

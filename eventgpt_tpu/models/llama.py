@@ -287,11 +287,13 @@ def prefill(
     ``generate`` consumes; skipping the other T-1 lm_head columns saves
     T x vocab f32 per row (0.66 GB at B=8, S=640).
 
-    ``attn_impl == "ring"`` with a ``mesh`` whose ``context`` axis is > 1
-    runs sequence-parallel ring attention (``parallel/ring.py``): the
-    sequence axis shards over ``context`` and KV blocks rotate via
-    ppermute. T must divide the context axis size. Falls back to dense on a
-    context-1 mesh.
+    ``attn_impl == "ring"`` (or ``"ulysses"``) with a ``mesh`` whose
+    ``context`` axis is > 1 runs sequence-parallel attention: ring rotates
+    KV blocks via ppermute (``parallel/ring.py``); ulysses re-shards
+    sequence<->heads with two all-to-alls and runs full-sequence local
+    attention (``parallel/ulysses.py``; local heads must divide by the
+    context size). T must divide the context axis size. Both fall back to
+    dense on a context-1 mesh.
     """
     b, t, d = inputs_embeds.shape
     positions = jnp.cumsum(attention_mask.astype(jnp.int32), axis=1) - 1
@@ -299,10 +301,15 @@ def prefill(
     cos, sin = rope_tables(cfg, positions)
 
     ring_fn = None
-    if cfg.attn_impl == "ring" and mesh is not None and mesh.shape["context"] > 1:
-        from eventgpt_tpu.parallel.ring import ring_attention_shard_map
+    if mesh is not None and mesh.shape["context"] > 1:
+        if cfg.attn_impl == "ring":
+            from eventgpt_tpu.parallel.ring import ring_attention_shard_map
 
-        ring_fn = ring_attention_shard_map(mesh, causal=True)
+            ring_fn = ring_attention_shard_map(mesh, causal=True)
+        elif cfg.attn_impl == "ulysses":
+            from eventgpt_tpu.parallel.ulysses import ulysses_attention_shard_map
+
+            ring_fn = ulysses_attention_shard_map(mesh, causal=True)
     use_flash = cfg.attn_impl == "flash"
     if use_flash or ring_fn is not None:
         mask = None  # causal + padding masks applied inline

@@ -121,13 +121,14 @@ def ring_attention_shard_map(mesh: Mesh, causal: bool = True,
     kv_valid) -> out``. This is the form model code calls *inside* its own
     jit (``models/llama.py`` when ``attn_impl == 'ring'``); shard_map
     composes with the surrounding GSPMD partitioning."""
-    qkv_spec = P(("data", "fsdp"), "context", "model", None)
-    valid_spec = P(("data", "fsdp"), "context")
+    from eventgpt_tpu.parallel.sp_common import SP_QKV_SPEC, SP_VALID_SPEC
+
     return jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal),
         mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, valid_spec, valid_spec),
-        out_specs=qkv_spec,
+        in_specs=(SP_QKV_SPEC, SP_QKV_SPEC, SP_QKV_SPEC,
+                  SP_VALID_SPEC, SP_VALID_SPEC),
+        out_specs=SP_QKV_SPEC,
     )
 
 

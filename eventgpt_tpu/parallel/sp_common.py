@@ -1,0 +1,12 @@
+"""Shared scaffolding for the two sequence-parallel attention modes
+(ring / ulysses): the partition specs both shard_maps use. Kept in one
+place so a mesh-axis change cannot desynchronize them.
+"""
+
+from jax.sharding import PartitionSpec as P
+
+# q/k/v (B, S, H, hd): batch over (data, fsdp), sequence over context,
+# heads over model.
+SP_QKV_SPEC = P(("data", "fsdp"), "context", "model", None)
+# validity masks (B, S).
+SP_VALID_SPEC = P(("data", "fsdp"), "context")

@@ -1,0 +1,121 @@
+"""Ulysses-style all-to-all sequence parallelism over the ``context`` axis.
+
+The second context-parallel mode next to ring attention
+(``parallel/ring.py``): instead of rotating KV blocks around a ring (one
+ppermute per step, compute overlapping transfer), Ulysses re-shards with two
+collectives — an all-to-all that trades the sequence shard for a HEAD shard
+(each device ends up with the FULL sequence for H/C of the heads), a plain
+local attention over the complete sequence, and an inverse all-to-all back
+to sequence sharding. (DeepSpeed-Ulysses; the reference stack has neither
+mode — SURVEY.md §2.4.)
+
+Trade-off vs ring: Ulysses moves O(S·H·hd / C) twice per layer regardless of
+the context size and runs attention as one dense local call (simple, fast
+when heads are plentiful and ICI all-to-all is cheap — the v5e torus);
+ring's traffic is comparable but pipelined across C steps, and it keeps
+full-head locality (no H % C divisibility requirement). Both enforce
+causality with global positions and are dense-equivalent up to f32
+summation order; `LlamaConfig.attn_impl` picks "ring" or "ulysses".
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+
+def _ulysses_attention_local(
+    q: jnp.ndarray,        # (B, S/C, H, hd) local sequence chunk, all heads
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_valid: jnp.ndarray,   # (B, S/C) bool
+    kv_valid: jnp.ndarray,  # (B, S/C) bool
+    axis_name: str,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Per-shard body (inside shard_map): all-to-all -> full-sequence local
+    attention on a head shard -> inverse all-to-all."""
+    # seq-shard -> head-shard: device j receives head block j over the FULL
+    # sequence (chunks concatenate in axis order = global token order).
+    qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kvv = lax.all_gather(kv_valid, axis_name, axis=1, tiled=True)  # (B, S)
+
+    b, s, hc, hd = qh.shape
+    scale = 1.0 / math.sqrt(hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32) * scale
+    mask = kvv[:, None, None, :]
+    if causal:
+        pos = jnp.arange(s)
+        mask = mask & (pos[None, None, None, :] <= pos[None, None, :, None])
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vh.dtype), vh,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+
+    # head-shard -> seq-shard (exact inverse exchange).
+    out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    return jnp.where(q_valid[:, :, None, None], out, 0.0)
+
+
+def ulysses_attention_shard_map(mesh: Mesh, causal: bool = True,
+                                axis_name: str = "context"):
+    """Un-jitted shard_map: ``f(q, k, v, q_valid, kv_valid) -> out`` with the
+    same calling convention as ``ring_attention_shard_map`` — the form
+    ``models/llama.py`` calls inside its own jit when
+    ``attn_impl == "ulysses"``. LOCAL heads (H / model) must divide by the
+    context size (heads re-shard across the axis); validated here at trace
+    time so every caller gets the friendly error, not a shard_map failure."""
+    from eventgpt_tpu.parallel.sp_common import SP_QKV_SPEC, SP_VALID_SPEC
+
+    inner = jax.shard_map(
+        functools.partial(_ulysses_attention_local, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh,
+        in_specs=(SP_QKV_SPEC, SP_QKV_SPEC, SP_QKV_SPEC,
+                  SP_VALID_SPEC, SP_VALID_SPEC),
+        out_specs=SP_QKV_SPEC,
+    )
+
+    def checked(q, k, v, q_valid, kv_valid):
+        local_heads = q.shape[2] // mesh.shape["model"]
+        ctx = mesh.shape[axis_name]
+        if local_heads % max(ctx, 1):
+            raise ValueError(
+                f"ulysses re-shards heads over the context axis: "
+                f"H/model = {local_heads} must divide by context={ctx} "
+                f"(use ring attention otherwise)"
+            )
+        return inner(q, k, v, q_valid, kv_valid)
+
+    return checked
+
+
+def ulysses_self_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    valid: Optional[jnp.ndarray] = None,
+    causal: bool = True,
+    axis_name: str = "context",
+) -> jnp.ndarray:
+    """Jitted convenience entry: global-shape q/k/v (B, S, H, hd); S must
+    divide by the context axis and H by (context x model)."""
+    b, s, h, hd = q.shape
+    if valid is None:
+        valid = jnp.ones((b, s), bool)
+    return _ulysses_jitted(mesh, causal, axis_name)(q, k, v, valid, valid)
+
+
+@functools.lru_cache(maxsize=32)
+def _ulysses_jitted(mesh: Mesh, causal: bool, axis_name: str):
+    return jax.jit(ulysses_attention_shard_map(mesh, causal, axis_name))

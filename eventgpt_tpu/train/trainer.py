@@ -88,12 +88,20 @@ class Trainer:
                 cfg, llama=dataclasses.replace(cfg.llama, attn_impl=train_args.attn_impl)
             )
         ctx = mesh.shape["context"]
-        if ctx > 1 and cfg.llama.attn_impl != "ring":
+        if ctx > 1 and cfg.llama.attn_impl not in ("ring", "ulysses"):
             raise ValueError(
-                "mesh_context > 1 requires attn_impl='ring' (sequence-parallel "
-                "ring attention); dense/flash attention cannot consume a "
-                "context-sharded sequence"
+                "mesh_context > 1 requires attn_impl='ring' or 'ulysses' "
+                "(sequence parallelism); dense/flash attention cannot "
+                "consume a context-sharded sequence"
             )
+        if ctx > 1 and cfg.llama.attn_impl == "ulysses":
+            local_heads = cfg.llama.num_heads // mesh.shape["model"]
+            if local_heads % ctx:
+                raise ValueError(
+                    f"attn_impl='ulysses' re-shards heads over context: "
+                    f"num_heads/model = {local_heads} must divide by "
+                    f"mesh_context={ctx} (use attn_impl='ring' otherwise)"
+                )
         if ctx > 1 and 64 % ctx:
             # Collated batches pad T to a multiple of the 64-token bucket
             # (train/data.py:collate_fixed_layout), so a context size that
