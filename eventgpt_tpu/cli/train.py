@@ -21,7 +21,6 @@ from typing import Optional, get_args, get_origin
 
 import jax
 
-from eventgpt_tpu.config import EventChatConfig
 from eventgpt_tpu.parallel.dist import initialize_distributed
 from eventgpt_tpu.train.args import DataArguments, ModelArguments, TrainingArguments
 from eventgpt_tpu.train.trainer import Trainer

@@ -201,7 +201,6 @@ class EventChatDataset:
 
     def _load_pixels(self, entry: Dict[str, Any]) -> Optional[np.ndarray]:
         from eventgpt_tpu.ops.image import clip_preprocess_batch, process_event_file
-        from eventgpt_tpu.ops.raster import events_to_frames
 
         if "event" in entry:
             path = os.path.join(self.event_folder, entry["event"])

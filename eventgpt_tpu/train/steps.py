@@ -206,7 +206,7 @@ def batch_to_device(batch: Dict[str, Any], mesh=None) -> Batch:
     """Host batch -> device, sharded over (data, fsdp) when a mesh is given."""
     if mesh is None:
         return {k: jnp.asarray(v) for k, v in batch.items()}
-    from jax.sharding import NamedSharding, PartitionSpec
+    from jax.sharding import NamedSharding
 
     from eventgpt_tpu.parallel.sharding import batch_spec
 
