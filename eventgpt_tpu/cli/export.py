@@ -1,0 +1,103 @@
+"""Export a (finetuned) model as an HF-style EventChat_llama checkpoint.
+
+The handoff path BACK to the reference stack: merge this framework's
+training artifacts (stage-1 projector npz, stage-2 LoRA npz) into the base
+weights and write a sharded-safetensors directory + config.json in the
+reference's layout (prefix conventions per ``model/EventChatModel.py:
+72-76,128-161``) — loadable by ``EventChatModel.from_pretrained`` or back
+by this framework's own CLIs.
+
+Usage:
+  python -m eventgpt_tpu.cli.export --model_path <base ckpt|tiny-random>
+      [--projector projector_last.npz] [--lora lora_last.npz
+       --lora_r 64 --lora_alpha 16] --output_dir exported/
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Export HF-style checkpoint")
+    p.add_argument("--model_path", type=str, required=True,
+                   help="base checkpoint dir (or tiny-random)")
+    p.add_argument("--output_dir", type=str, required=True)
+    p.add_argument("--projector", type=str, default=None,
+                   help="stage-1 artifact (model.visual_projector.* npz)")
+    p.add_argument("--lora", type=str, default=None,
+                   help="stage-2 artifact (lora.* npz) — merged into the LM")
+    p.add_argument("--query_embedder", type=str, default=None,
+                   help="trained Q-Former query artifact (re-exported as a "
+                        "sibling component of the checkpoint)")
+    p.add_argument("--attention_layers", type=str, default=None)
+    p.add_argument("--lora_r", type=int, default=64)
+    p.add_argument("--lora_alpha", type=float, default=16.0)
+    p.add_argument("--num_shards", type=int, default=2)
+    p.add_argument("--visual_tower", type=str,
+                   default="openai/clip-vit-large-patch14-336")
+    return p
+
+
+def main(argv=None) -> str:
+    args = build_parser().parse_args(argv)
+    import jax
+    import numpy as np
+
+    from eventgpt_tpu import checkpoint as ckpt
+    from eventgpt_tpu.cli.infer import load_model
+    from eventgpt_tpu.models.convert import write_hf_checkpoint
+
+    cfg, params, _ = load_model(args.model_path, "float32")
+    params = jax.tree_util.tree_map(np.asarray, params)
+
+    if args.projector:
+        params["projector"] = ckpt.load_component(
+            args.projector, strip_prefix="model.visual_projector."
+        )
+    if args.query_embedder or args.attention_layers:
+        import dataclasses
+
+        from eventgpt_tpu.models.qformer import (
+            init_qformer_params, load_qformer_components,
+            qformer_config_from_artifacts,
+        )
+
+        if not cfg.use_event_qformer:
+            cfg = dataclasses.replace(
+                cfg, use_event_qformer=True,
+                qformer=qformer_config_from_artifacts(
+                    args.query_embedder, args.attention_layers
+                ),
+            )
+        if "qformer" not in params:
+            params["qformer"] = jax.tree_util.tree_map(
+                np.asarray, init_qformer_params(cfg.qformer, jax.random.PRNGKey(1))
+            )
+        params["qformer"] = jax.tree_util.tree_map(np.asarray, load_qformer_components(
+            params["qformer"],
+            query_embedder_path=args.query_embedder,
+            attention_layers_path=args.attention_layers,
+        ))
+    if args.lora:
+        from eventgpt_tpu.train.lora import LoraConfig, merge_lora
+
+        lora_tree = ckpt.load_component(args.lora, strip_prefix="lora.")
+        params["llama"] = merge_lora(
+            params["llama"], lora_tree,
+            LoraConfig(r=args.lora_r, alpha=args.lora_alpha),
+        )
+        params["llama"] = jax.tree_util.tree_map(np.asarray, params["llama"])
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    out = write_hf_checkpoint(params, cfg, args.output_dir,
+                              num_shards=args.num_shards,
+                              visual_tower=args.visual_tower)
+    n_files = len(os.listdir(out))
+    print(f"exported {out} ({n_files} files)")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -159,15 +159,26 @@ def prepare_model(cfg, params, tokenizer, args):
                     args.pretrain_query_embedder, args.pretrain_attention_layers
                 )
             cfg = dataclasses.replace(cfg, use_event_qformer=True, qformer=qcfg)
+        # Component artifacts exported next to the checkpoint
+        # (models/convert.py:write_hf_checkpoint) load automatically;
+        # explicit flags override.
+        qe_path = args.pretrain_query_embedder
+        al_path = args.pretrain_attention_layers
+        if qe_path is None and os.path.isdir(args.model_path):
+            cand = os.path.join(args.model_path, "query_embedder.npz")
+            qe_path = cand if os.path.exists(cand) else None
+        if al_path is None and os.path.isdir(args.model_path):
+            cand = os.path.join(args.model_path, "attention_layers.npz")
+            al_path = cand if os.path.exists(cand) else None
         if "qformer" not in params:
             params["qformer"] = init_qformer_params(
                 cfg.qformer, jax.random.PRNGKey(args.seed + 1)
             )
-        if args.pretrain_query_embedder or args.pretrain_attention_layers:
+        if qe_path or al_path:
             params["qformer"] = load_qformer_components(
                 params["qformer"],
-                query_embedder_path=args.pretrain_query_embedder,
-                attention_layers_path=args.pretrain_attention_layers,
+                query_embedder_path=qe_path,
+                attention_layers_path=al_path,
             )
 
     if cfg.mm_use_im_patch_token:

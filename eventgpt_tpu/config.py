@@ -301,6 +301,7 @@ def from_hf_config(hf: dict, attn_impl: Optional[str] = None) -> EventChatConfig
     proj = ProjectorConfig(
         input_dim=vision.hidden_size,
         output_dim=llama.hidden_size,
+        mlp_depth=hf.get("mm_projector_depth", 2),
         use_feature_adaptor="event_feature_adaptor" in hf,
     )
     # Value-respecting gate: a parsed config.json dict contains explicit

@@ -253,6 +253,93 @@ def eventchat_params_to_hf(params: Params, cfg: EventChatConfig) -> StateDict:
     return sd
 
 
+def hf_config_dict(cfg: EventChatConfig,
+                   visual_tower: str = "openai/clip-vit-large-patch14-336",
+                   has_adaptor: Optional[bool] = None,
+                   include_qformer: Optional[bool] = None) -> dict:
+    """EventChatConfig -> the reference's ``config.json`` field set
+    (custom gating fields per ``model/EventChatModel.py:71-81`` +
+    ``inference.py:33-34``), plus this framework's explicit extensions
+    (``vision_config``, ``mm_projector_depth``, ``qformer_config``) so
+    non-default towers/projectors round-trip.
+
+    ``has_adaptor`` / ``include_qformer`` override the cfg-derived gates —
+    presence fields must track the TENSORS actually persisted next to this
+    config, not the config object (a gate without weights makes the
+    reference stack construct an unloaded module and makes this framework
+    fabricate a fresh one)."""
+    from eventgpt_tpu.config import to_dict
+
+    out = {
+        "model_type": "EventChat_llama",
+        "architectures": ["EventChatModel"],
+        "vocab_size": cfg.llama.vocab_size,
+        "hidden_size": cfg.llama.hidden_size,
+        "intermediate_size": cfg.llama.intermediate_size,
+        "num_hidden_layers": cfg.llama.num_layers,
+        "num_attention_heads": cfg.llama.num_heads,
+        "num_key_value_heads": cfg.llama.num_kv_heads,
+        "rms_norm_eps": cfg.llama.rms_norm_eps,
+        "rope_theta": cfg.llama.rope_theta,
+        "max_position_embeddings": cfg.llama.max_seq_len,
+        "tie_word_embeddings": cfg.llama.tie_word_embeddings,
+        "mm_visual_tower": visual_tower,
+        "mm_projector_depth": cfg.projector.mlp_depth,
+        "spatial_temporal_encoder": cfg.use_spatio_temporal_pool,
+        "mm_use_im_start_end": cfg.mm_use_im_start_end,
+        "mm_use_im_patch_token": cfg.mm_use_im_patch_token,
+        "vision_config": to_dict(cfg.vision),
+    }
+    adaptor = (cfg.projector.use_feature_adaptor if has_adaptor is None
+               else has_adaptor)
+    if adaptor:
+        out["event_feature_adaptor"] = True
+    qformer = (cfg.use_event_qformer if include_qformer is None
+               else include_qformer)
+    if qformer:
+        out["use_event_qformer"] = True
+        out["qformer_config"] = to_dict(cfg.qformer)
+    return out
+
+
+def write_hf_checkpoint(params: Params, cfg: EventChatConfig, out_dir: str,
+                        num_shards: int = 2,
+                        visual_tower: str = "openai/clip-vit-large-patch14-336") -> str:
+    """Full JAX tree -> loadable HF-style checkpoint directory (sharded
+    safetensors + config.json). The handoff artifact for reference-stack
+    users; inverse of ``load_state_dict`` + ``eventchat_params_from_hf``."""
+    import json
+
+    import jax
+
+    sd = eventchat_params_to_hf(
+        jax.tree_util.tree_map(np.asarray, params), cfg
+    )
+    save_sharded_safetensors(sd, out_dir, num_shards=num_shards)
+    # Q-Former weights have no place inside the reference's state dict
+    # (its load path is per-component files, model/EventChatModel.py:
+    # 141-163) — persist them as sibling component artifacts, and only
+    # advertise the gate when the weights actually ship.
+    has_qformer = cfg.use_event_qformer and "qformer" in params
+    if has_qformer:
+        from eventgpt_tpu.models.qformer import save_qformer_components
+
+        save_qformer_components(
+            params["qformer"],
+            os.path.join(out_dir, "query_embedder.npz"),
+            os.path.join(out_dir, "attention_layers.npz"),
+            num_heads=cfg.qformer.num_heads,
+        )
+    cfg_dict = hf_config_dict(
+        cfg, visual_tower,
+        has_adaptor="adaptor" in params.get("projector", {}),
+        include_qformer=has_qformer,
+    )
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(cfg_dict, f, indent=2)
+    return out_dir
+
+
 def save_sharded_safetensors(sd: StateDict, out_dir: str, num_shards: int = 2) -> None:
     """Write an HF-style sharded safetensors checkpoint directory
     (``model-0000i-of-0000N.safetensors`` + ``model.safetensors.index.json``)."""

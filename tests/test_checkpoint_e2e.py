@@ -37,32 +37,9 @@ def _tiny_cfg() -> EventChatConfig:
 
 def _write_checkpoint(tmp_path, cfg, params) -> str:
     out = os.path.join(str(tmp_path), "ckpt")
-    sd = convert.eventchat_params_to_hf(
-        jax.tree_util.tree_map(np.asarray, params), cfg
-    )
-    convert.save_sharded_safetensors(sd, out, num_shards=2)
-    hf_cfg = {
-        "model_type": "EventChat_llama",
-        "architectures": ["EventChatModel"],
-        "vocab_size": cfg.llama.vocab_size,
-        "hidden_size": cfg.llama.hidden_size,
-        "intermediate_size": cfg.llama.intermediate_size,
-        "num_hidden_layers": cfg.llama.num_layers,
-        "num_attention_heads": cfg.llama.num_heads,
-        "num_key_value_heads": cfg.llama.num_kv_heads,
-        "rms_norm_eps": cfg.llama.rms_norm_eps,
-        "rope_theta": cfg.llama.rope_theta,
-        "max_position_embeddings": cfg.llama.max_seq_len,
-        "mm_visual_tower": "openai/clip-vit-tiny-test",
-        "event_feature_adaptor": True,
-        "spatial_temporal_encoder": True,
-        "mm_use_im_start_end": False,
-        "mm_use_im_patch_token": True,
-        # This framework's extension: explicit tower dims for non-ViT-L towers.
-        "vision_config": to_dict(cfg.vision),
-    }
-    with open(os.path.join(out, "config.json"), "w") as f:
-        json.dump(hf_cfg, f, indent=2)
+    os.makedirs(out, exist_ok=True)
+    convert.write_hf_checkpoint(params, cfg, out, num_shards=2,
+                                visual_tower="openai/clip-vit-tiny-test")
     return out
 
 
@@ -133,3 +110,119 @@ def test_cli_infer_from_real_format_checkpoint(tmp_path, capsys):
     )[0]
     answer_direct = tokenizer.batch_decode([out_ids], skip_special_tokens=True)[0].strip()
     assert answer_cli == answer_direct
+
+
+def test_export_cli_roundtrip(tmp_path):
+    """cli/export.py writes a checkpoint directory that reproduces the
+    source model's greedy answers when loaded back through the infer CLI —
+    the handoff artifact for reference-stack users."""
+    from eventgpt_tpu.cli import export as export_cli
+    from eventgpt_tpu.cli import infer as infer_cli
+
+    out_dir = str(tmp_path / "exported")
+    export_cli.main(["--model_path", "tiny-random",
+                     "--output_dir", out_dir, "--num_shards", "2"])
+    assert os.path.exists(os.path.join(out_dir, "config.json"))
+    assert os.path.exists(
+        os.path.join(out_dir, "model.safetensors.index.json"))
+
+    sample = "/root/reference/samples/sample1.npy"
+    if not os.path.exists(sample):
+        pytest.skip("reference sample not available")
+    common = ["--event_frame", sample, "--query", "What?",
+              "--temperature", "0", "--max_new_tokens", "6",
+              "--dtype", "float32"]
+    a = infer_cli.main(["--model_path", "tiny-random"] + common)
+    b = infer_cli.main(["--model_path", out_dir,
+                        "--tokenizer_path", "byte"] + common)
+    assert a == b
+
+
+def test_export_cli_merges_lora(tmp_path):
+    """--lora merges a stage-2 artifact into the exported LM weights."""
+    from eventgpt_tpu.cli import export as export_cli
+    from eventgpt_tpu.train.lora import LoraConfig, init_lora_params
+
+    cfg = EventChatConfig.tiny()
+    lcfg = LoraConfig(r=4, alpha=8.0)
+    lora = init_lora_params(cfg.llama, lcfg, jax.random.PRNGKey(7), np.float32)
+    # Standard LoRA init zeroes the B factor (identity merge); randomize the
+    # whole tree so the merge visibly changes the targeted projections.
+    leaves, treedef = jax.tree_util.tree_flatten(lora)
+    keys = jax.random.split(jax.random.PRNGKey(8), len(leaves))
+    lora = jax.tree_util.tree_unflatten(
+        treedef, [0.1 * jax.random.normal(k, l.shape, l.dtype)
+                  for k, l in zip(keys, leaves)]
+    )
+    from eventgpt_tpu import checkpoint as ckpt_mod
+
+    lora_npz = str(tmp_path / "lora_last.npz")
+    ckpt_mod.save_component(lora_npz, jax.device_get(lora), prefix="lora.")
+
+    plain_dir = str(tmp_path / "plain")
+    lora_dir = str(tmp_path / "with_lora")
+    export_cli.main(["--model_path", "tiny-random", "--output_dir", plain_dir])
+    export_cli.main(["--model_path", "tiny-random", "--output_dir", lora_dir,
+                     "--lora", lora_npz, "--lora_r", "4",
+                     "--lora_alpha", "8"])
+    sd_plain = convert.load_state_dict(plain_dir)
+    sd_lora = convert.load_state_dict(lora_dir)
+    # LoRA-targeted projections differ; untouched tensors are identical.
+    assert not np.allclose(
+        sd_plain["model.layers.0.self_attn.q_proj.weight"],
+        sd_lora["model.layers.0.self_attn.q_proj.weight"])
+    np.testing.assert_array_equal(
+        sd_plain["model.embed_tokens.weight"],
+        sd_lora["model.embed_tokens.weight"])
+
+
+def test_export_roundtrips_qformer_components(tmp_path):
+    """A Q-Former export ships the component artifacts beside the
+    checkpoint, the config gate tracks them, and the infer CLI auto-loads
+    them so the exported model answers like the source."""
+    from eventgpt_tpu.cli import export as export_cli
+    from eventgpt_tpu.cli import infer as infer_cli
+    from eventgpt_tpu.config import QFormerConfig
+    from eventgpt_tpu.models import qformer as qf
+
+    qcfg = QFormerConfig(num_queries=6, num_layers=2, num_heads=2,
+                         hidden_size=64, mlp_ratio=2)
+    qparams = qf.init_qformer_params(qcfg, jax.random.PRNGKey(9))
+    qp = str(tmp_path / "query_embedder_last.npz")
+    ap = str(tmp_path / "attention_layers_last.npz")
+    qf.save_qformer_components(jax.device_get(qparams), qp, ap,
+                               num_heads=qcfg.num_heads)
+
+    out_dir = str(tmp_path / "exported_qf")
+    export_cli.main(["--model_path", "tiny-random", "--output_dir", out_dir,
+                     "--query_embedder", qp, "--attention_layers", ap])
+    assert os.path.exists(os.path.join(out_dir, "query_embedder.npz"))
+    assert os.path.exists(os.path.join(out_dir, "attention_layers.npz"))
+    cfg_json = json.load(open(os.path.join(out_dir, "config.json")))
+    assert cfg_json["use_event_qformer"] is True
+
+    sample = "/root/reference/samples/sample1.npy"
+    if not os.path.exists(sample):
+        pytest.skip("reference sample not available")
+    common = ["--event_frame", sample, "--query", "What?",
+              "--temperature", "0", "--max_new_tokens", "4",
+              "--dtype", "float32"]
+    # Source: tiny-random gated with the same artifacts; export: auto-load.
+    a = infer_cli.main(["--model_path", "tiny-random", "--use_event_qformer",
+                        "--pretrain_query_embedder", qp,
+                        "--pretrain_attention_layers", ap] + common)
+    b = infer_cli.main(["--model_path", out_dir,
+                        "--tokenizer_path", "byte"] + common)
+    assert a == b
+
+
+def test_export_without_qformer_has_no_gate(tmp_path):
+    """A plain export must NOT advertise use_event_qformer (a gate without
+    weights would make reloads fabricate a random Q-Former)."""
+    from eventgpt_tpu.cli import export as export_cli
+
+    out_dir = str(tmp_path / "plain_export")
+    export_cli.main(["--model_path", "tiny-random", "--output_dir", out_dir])
+    cfg_json = json.load(open(os.path.join(out_dir, "config.json")))
+    assert "use_event_qformer" not in cfg_json
+    assert cfg_json["mm_projector_depth"] == 2
