@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Batched transcript-parity evaluation (BASELINE config 2): greedy answers
+# across N event files in one generate call; set EXPECTED to a JSON list of
+# reference answers to gate (nonzero exit on mismatch).
+set -euo pipefail
+MODEL_PATH=${MODEL_PATH:-tiny-random}
+python -m eventgpt_tpu.cli.eval \
+  --model_path "$MODEL_PATH" \
+  --event_frames "${EVENT_FRAMES:-/root/reference/samples/sample1.npy}" \
+  --query "${QUERY:-What is happening in this scene?}" \
+  --temperature 0 \
+  ${EXPECTED:+--expected "$EXPECTED"} \
+  "$@"

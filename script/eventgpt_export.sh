@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Export (optionally finetuned) weights as an HF-style EventChat_llama
+# checkpoint directory the reference stack can from_pretrained.
+set -euo pipefail
+MODEL_PATH=${MODEL_PATH:-tiny-random}
+OUTPUT_DIR=${OUTPUT_DIR:?set OUTPUT_DIR}
+python -m eventgpt_tpu.cli.export \
+  --model_path "$MODEL_PATH" \
+  --output_dir "$OUTPUT_DIR" \
+  ${PROJECTOR:+--projector "$PROJECTOR"} \
+  ${LORA:+--lora "$LORA"} \
+  "$@"
