@@ -49,14 +49,35 @@ def main(argv=None) -> str:
     from eventgpt_tpu.cli.infer import load_model
     from eventgpt_tpu.models.convert import write_hf_checkpoint
 
-    cfg, params, _ = load_model(args.model_path, "float32")
+    # Weight export never touches the tokenizer; the byte fallback avoids
+    # requiring tokenizer files in the source checkpoint dir.
+    cfg, params, _ = load_model(args.model_path, "float32",
+                                tokenizer_path="byte")
     params = jax.tree_util.tree_map(np.asarray, params)
 
     if args.projector:
         params["projector"] = ckpt.load_component(
             args.projector, strip_prefix="model.visual_projector."
         )
-    if args.query_embedder or args.attention_layers:
+    # Re-exporting a Q-Former checkpoint must not silently drop it: pick up
+    # the sibling component artifacts write_hf_checkpoint itself emits when
+    # no explicit flags are given.
+    qe_path, al_path = args.query_embedder, args.attention_layers
+    if os.path.isdir(args.model_path):
+        if qe_path is None:
+            cand = os.path.join(args.model_path, "query_embedder.npz")
+            qe_path = cand if os.path.exists(cand) else None
+        if al_path is None:
+            cand = os.path.join(args.model_path, "attention_layers.npz")
+            al_path = cand if os.path.exists(cand) else None
+    if cfg.use_event_qformer and not (qe_path and al_path):
+        raise ValueError(
+            f"{args.model_path} gates use_event_qformer but no Q-Former "
+            f"component artifacts were found or given "
+            f"(--query_embedder/--attention_layers); refusing to export a "
+            f"checkpoint that would silently lose the module"
+        )
+    if qe_path or al_path:
         import dataclasses
 
         from eventgpt_tpu.models.qformer import (
@@ -67,9 +88,7 @@ def main(argv=None) -> str:
         if not cfg.use_event_qformer:
             cfg = dataclasses.replace(
                 cfg, use_event_qformer=True,
-                qformer=qformer_config_from_artifacts(
-                    args.query_embedder, args.attention_layers
-                ),
+                qformer=qformer_config_from_artifacts(qe_path, al_path),
             )
         if "qformer" not in params:
             params["qformer"] = jax.tree_util.tree_map(
@@ -77,8 +96,8 @@ def main(argv=None) -> str:
             )
         params["qformer"] = jax.tree_util.tree_map(np.asarray, load_qformer_components(
             params["qformer"],
-            query_embedder_path=args.query_embedder,
-            attention_layers_path=args.attention_layers,
+            query_embedder_path=qe_path,
+            attention_layers_path=al_path,
         ))
     if args.lora:
         from eventgpt_tpu.train.lora import LoraConfig, merge_lora

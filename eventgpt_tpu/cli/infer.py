@@ -171,6 +171,17 @@ def prepare_model(cfg, params, tokenizer, args):
             cand = os.path.join(args.model_path, "attention_layers.npz")
             al_path = cand if os.path.exists(cand) else None
         if "qformer" not in params:
+            if (not (qe_path or al_path)) and not args.use_event_qformer:
+                # The gate came from the checkpoint's config but no weights
+                # exist anywhere: serving a freshly random-initialized
+                # Q-Former would silently answer garbage. (The explicit
+                # --use_event_qformer flag keeps fresh-init for smoke runs.)
+                raise ValueError(
+                    f"{args.model_path} gates use_event_qformer but no "
+                    f"component artifacts were found in the checkpoint dir "
+                    f"or given via --pretrain_query_embedder/"
+                    f"--pretrain_attention_layers"
+                )
             params["qformer"] = init_qformer_params(
                 cfg.qformer, jax.random.PRNGKey(args.seed + 1)
             )

@@ -89,9 +89,14 @@ class EventStream:
     optionally paced at wall-clock rate; ``pop_until(horizon)`` returns every
     event with t <= horizon as numpy arrays, splitting a straddling packet
     exactly like the reference consumer.
+
+    ``time_unit``: txt timestamp unit — "auto" treats a max value > 1e5 as
+    microseconds; microsecond recordings shorter than 0.1 s are ambiguous
+    under auto and must pass "microseconds" explicitly.
     """
 
-    def __init__(self, path: str, paced: bool = False, pace_factor: float = 1.0):
+    def __init__(self, path: str, paced: bool = False, pace_factor: float = 1.0,
+                 time_unit: str = "auto"):
         lib = load_library()
         if lib is None:
             raise RuntimeError(
@@ -100,6 +105,7 @@ class EventStream:
         self._lib = lib
         lib.egpt_stream_open.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+            ctypes.c_int,
         ]
         lib.egpt_stream_open.restype = ctypes.c_void_p
         lib.egpt_stream_pop_until.argtypes = [ctypes.c_void_p, ctypes.c_double]
@@ -116,8 +122,12 @@ class EventStream:
         lib.egpt_stream_close.restype = None
 
         is_npy = 1 if path.endswith(".npy") else 0
+        units = {"auto": 0, "seconds": 1, "microseconds": 2}
+        if time_unit not in units:
+            raise ValueError(f"time_unit must be one of {sorted(units)}")
         self._handle = lib.egpt_stream_open(
-            path.encode(), is_npy, 1 if paced else 0, float(pace_factor)
+            path.encode(), is_npy, 1 if paced else 0, float(pace_factor),
+            units[time_unit],
         )
         if not self._handle:
             raise FileNotFoundError(f"could not open event stream {path}")

@@ -121,8 +121,8 @@ def make_train_step(
     closure-free constant argument, which is the whole freeze mechanism
     (no requires_grad bookkeeping as in the reference).
 
-    ``mesh`` enables sequence-parallel attention (ring or ulysses, per ``cfg.llama.attn_impl``) when its ``context``
-    axis is > 1 and ``cfg.llama.attn_impl == "ring"``.
+    ``mesh`` enables sequence-parallel attention when its ``context`` axis
+    is > 1 and ``cfg.llama.attn_impl`` is ``"ring"`` or ``"ulysses"``.
     """
 
     @functools.partial(

@@ -33,12 +33,18 @@ struct EventPacket {
   double t_begin = 0, t_end = 0;
 };
 
+enum class TimeUnit { kAuto = 0, kSeconds = 1, kMicroseconds = 2 };
+
 class EventsDataIO {
  public:
   struct Options {
     double packet_us = 1000.0;  // ~1 ms packets (EventsDataIO.cpp:386-402)
     bool paced = false;         // replay at wall-clock rate
     double pace_factor = 1.0;   // >1 = faster than real time
+    // Txt timestamp unit. kAuto: max value > 1e5 means microseconds —
+    // ambiguous for microsecond recordings shorter than 0.1 s, which must
+    // set kMicroseconds explicitly.
+    TimeUnit time_unit = TimeUnit::kAuto;
   };
 
   // Two ctors instead of a defaulted Options argument: GCC rejects nested-
@@ -88,6 +94,7 @@ class EventsDataIO {
 // Returns false on parse failure. Handles structured dtypes with x/y/t/p
 // fields of unsigned/signed integer or float types, little-endian.
 bool LoadEventsNpy(const std::string& path, std::vector<Event>& out);
-bool LoadEventsTxt(const std::string& path, std::vector<Event>& out);
+bool LoadEventsTxt(const std::string& path, std::vector<Event>& out,
+                   TimeUnit unit = TimeUnit::kAuto);
 
 }  // namespace egpt

@@ -88,12 +88,15 @@ struct EgptStream {
 
 // Open a file-backed stream. is_npy selects the structured-npy reader vs
 // the "t x y p" txt reader; paced != 0 replays at wall-clock rate scaled
-// by pace_factor. Returns nullptr on open failure.
+// by pace_factor; time_unit: 0 auto-detect, 1 seconds, 2 microseconds
+// (txt only — short microsecond recordings are ambiguous under auto).
+// Returns nullptr on open failure.
 void* egpt_stream_open(const char* path, int is_npy, int paced,
-                       double pace_factor) {
+                       double pace_factor, int time_unit) {
   egpt::EventsDataIO::Options opts;
   opts.paced = paced != 0;
   opts.pace_factor = pace_factor > 0 ? pace_factor : 1.0;
+  opts.time_unit = static_cast<egpt::TimeUnit>(time_unit);
   auto* s = new EgptStream(opts);
   const bool ok = is_npy ? s->io.GoOfflineNpy(path) : s->io.GoOfflineTxt(path);
   if (!ok) {

@@ -147,7 +147,8 @@ bool LoadEventsNpy(const std::string& path, std::vector<Event>& out) {
   return true;
 }
 
-bool LoadEventsTxt(const std::string& path, std::vector<Event>& out) {
+bool LoadEventsTxt(const std::string& path, std::vector<Event>& out,
+                   TimeUnit unit) {
   std::ifstream f(path);
   if (!f) return false;
   out.clear();
@@ -164,9 +165,15 @@ bool LoadEventsTxt(const std::string& path, std::vector<Event>& out) {
     e.p = static_cast<uint8_t>(p);
     out.push_back(e);
   }
-  // Unit detection on the full stream: timestamps beyond 1e5 "seconds"
-  // (28 h) mean the file is in microseconds (the DSEC/npy convention).
-  if (!out.empty() && out.back().t > 1e5) {
+  // Unit detection on the full stream's MAX (the file may be unsorted):
+  // timestamps beyond 1e5 "seconds" (28 h) mean microseconds (the DSEC/npy
+  // convention). Ambiguous for microsecond recordings shorter than 0.1 s —
+  // pass an explicit Options::time_unit for those.
+  if (unit == TimeUnit::kMicroseconds ||
+      (unit == TimeUnit::kAuto && !out.empty() &&
+       std::max_element(out.begin(), out.end(),
+                        [](const Event& a, const Event& b) { return a.t < b.t; })
+               ->t > 1e5)) {
     for (auto& e : out) e.t *= 1e-6;
   }
   return true;
@@ -208,7 +215,7 @@ void EventsDataIO::ProduceFromVector(std::vector<Event> events) {
 
 bool EventsDataIO::GoOfflineTxt(const std::string& path) {
   std::vector<Event> events;
-  if (!LoadEventsTxt(path, events)) return false;
+  if (!LoadEventsTxt(path, events, opts_.time_unit)) return false;
   Stop();
   stop_requested_ = false;
   producing_ = true;

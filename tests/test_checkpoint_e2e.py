@@ -226,3 +226,49 @@ def test_export_without_qformer_has_no_gate(tmp_path):
     cfg_json = json.load(open(os.path.join(out_dir, "config.json")))
     assert "use_event_qformer" not in cfg_json
     assert cfg_json["mm_projector_depth"] == 2
+
+
+def test_reexport_preserves_qformer_and_guards(tmp_path):
+    """Re-exporting a Q-Former checkpoint keeps the module (sibling
+    components auto-load); a gated checkpoint stripped of its components
+    refuses to export or serve rather than fabricating random weights."""
+    import shutil
+
+    from eventgpt_tpu.cli import export as export_cli
+    from eventgpt_tpu.cli import infer as infer_cli
+    from eventgpt_tpu.config import QFormerConfig
+    from eventgpt_tpu.models import qformer as qf
+
+    qcfg = QFormerConfig(num_queries=6, num_layers=2, num_heads=2,
+                         hidden_size=64, mlp_ratio=2)
+    qparams = qf.init_qformer_params(qcfg, jax.random.PRNGKey(11))
+    qp = str(tmp_path / "qe.npz")
+    ap = str(tmp_path / "al.npz")
+    qf.save_qformer_components(jax.device_get(qparams), qp, ap,
+                               num_heads=qcfg.num_heads)
+    first = str(tmp_path / "first")
+    export_cli.main(["--model_path", "tiny-random", "--output_dir", first,
+                     "--query_embedder", qp, "--attention_layers", ap])
+
+    # Re-export with no flags: components ride along, gate preserved.
+    second = str(tmp_path / "second")
+    export_cli.main(["--model_path", first, "--output_dir", second])
+    assert os.path.exists(os.path.join(second, "query_embedder.npz"))
+    assert json.load(open(os.path.join(second, "config.json")))[
+        "use_event_qformer"] is True
+
+    # Strip the components: export and serving both fail loudly.
+    stripped = str(tmp_path / "stripped")
+    shutil.copytree(first, stripped)
+    os.remove(os.path.join(stripped, "query_embedder.npz"))
+    os.remove(os.path.join(stripped, "attention_layers.npz"))
+    with pytest.raises(ValueError, match="use_event_qformer"):
+        export_cli.main(["--model_path", stripped,
+                         "--output_dir", str(tmp_path / "nope")])
+    sample = "/root/reference/samples/sample1.npy"
+    if os.path.exists(sample):
+        with pytest.raises(ValueError, match="use_event_qformer"):
+            infer_cli.main(["--model_path", stripped,
+                            "--tokenizer_path", "byte",
+                            "--event_frame", sample, "--query", "x",
+                            "--temperature", "0", "--max_new_tokens", "2"])

@@ -209,3 +209,27 @@ def test_stream_demo_end_to_end():
         "--max_windows", "2", "--max_new_tokens", "2",
     ])
     assert answered == 2
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib not built")
+def test_event_stream_txt_microsecond_override(tmp_path):
+    """A microsecond recording shorter than 0.1 s is ambiguous under unit
+    auto-detection; the explicit time_unit override resolves it."""
+    from eventgpt_tpu.native import EventStream
+
+    # 80 ms of integer-microsecond timestamps (max 80000 <= 1e5).
+    lines = [f"{i * 1000} {i % 7} {i % 5} {i % 2}" for i in range(80)]
+    path = tmp_path / "short_us.txt"
+    path.write_text("\n".join(lines) + "\n")
+
+    with EventStream(str(path), time_unit="microseconds") as stream:
+        got = []
+        deadline = time.time() + 5
+        while len(got) < 80 and time.time() < deadline:
+            got.extend(stream.pop_until(10.0)["t"].tolist())
+            time.sleep(0.002)
+        assert len(got) == 80
+        assert max(got) <= 0.080 + 1e-9  # seconds after conversion
+
+    with pytest.raises(ValueError, match="time_unit"):
+        EventStream(str(path), time_unit="bogus")
