@@ -52,6 +52,15 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["bfloat16", "float32"])
     p.add_argument("--quant", type=str, default="none",
                    choices=["none", "int8", "int4"])
+    p.add_argument("--kv_cache", type=str, default="bf16", choices=["bf16", "int8"],
+                   help="KV cache storage; int8 halves cache memory/bandwidth "
+                        "— the wide-batch (BASELINE config 2) serving knob")
+    p.add_argument("--fuse_params", action="store_true",
+                   help="fuse q|k|v and gate|up weights (5 matmuls/layer)")
+    # Serving mesh, same surface as cli/infer.py.
+    p.add_argument("--mesh_data", type=int, default=1)
+    p.add_argument("--mesh_fsdp", type=int, default=1)
+    p.add_argument("--mesh_model", type=int, default=1)
     # Q-Former serving, same surface as cli/infer.py.
     p.add_argument("--use_event_qformer", action="store_true")
     p.add_argument("--pretrain_query_embedder", type=str, default=None)
@@ -62,9 +71,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    from eventgpt_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     import numpy as np
 
-    from eventgpt_tpu.cli.infer import load_model, prepare_model
+    from eventgpt_tpu.cli.infer import (
+        load_model, prepare_model, serving_mesh_from_args,
+    )
 
     files = [f for f in args.event_frames.split(",") if f]
     if args.queries_json:
@@ -82,8 +96,10 @@ def main(argv=None):
         args.model_path, args.dtype, None, args.tokenizer_path
     )
     # Shared post-load prep (token registration, resize, quant, Q-Former
-    # gate-in, placement) — one implementation for both CLIs.
-    cfg, params = prepare_model(cfg, params, tokenizer, args)
+    # gate-in, placement) — one implementation for both CLIs. One mesh per
+    # run: params, activations, and the KV cache share the same Mesh object.
+    mesh = serving_mesh_from_args(args)
+    cfg, params = prepare_model(cfg, params, tokenizer, args, mesh=mesh)
     t_load = time.perf_counter() - t0
 
     # One batched preprocessing + generate pass over all samples.
@@ -109,6 +125,8 @@ def main(argv=None):
         seed=args.seed,
         max_context=args.context_len,
         num_beams=args.num_beams,
+        kv_quant=args.kv_cache == "int8",
+        mesh=mesh,
     )
     t_gen = time.perf_counter() - t0
 

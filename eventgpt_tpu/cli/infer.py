@@ -70,6 +70,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "group-128 packed nibbles, half int8's HBM traffic)")
     p.add_argument("--kv_cache", type=str, default="bf16", choices=["bf16", "int8"],
                    help="KV cache storage (int8 halves cache memory/bandwidth)")
+    p.add_argument("--fuse_params", action="store_true",
+                   help="fuse q|k|v and gate|up weights (5 matmuls/layer "
+                        "instead of 7; helps wide batches)")
+    # Serving mesh (BASELINE north star: pjit-sharded FSDP/TP serving).
+    # data*fsdp*model must equal the devices used; 1/1/1 = single chip.
+    p.add_argument("--mesh_data", type=int, default=1,
+                   help="data-parallel axis of the serving mesh")
+    p.add_argument("--mesh_fsdp", type=int, default=1,
+                   help="ZeRO/FSDP weight-sharding axis of the serving mesh")
+    p.add_argument("--mesh_model", type=int, default=1,
+                   help="tensor-parallel axis of the serving mesh")
     p.add_argument("--timing", action="store_true", help="print stage timings to stderr")
     # Q-Former serving (the use_event_qformer surface): enable the gate and
     # load the trained component artifacts written by the trainer
@@ -125,7 +136,7 @@ def place_params(tree, jdt):
     return jnp.asarray(tree, jdt)
 
 
-def prepare_model(cfg, params, tokenizer, args):
+def prepare_model(cfg, params, tokenizer, args, mesh=None):
     """Shared post-load preparation for the infer/eval CLIs: optional
     spatio-temporal / Q-Former config gating, special-token registration
     (parity with inference.py:33-39), embedding resize, host-side
@@ -201,6 +212,12 @@ def prepare_model(cfg, params, tokenizer, args):
         )
     if len(tokenizer) > cfg.llama.vocab_size:
         params["llama"] = resize_token_embeddings(params["llama"], len(tokenizer))
+    if getattr(args, "fuse_params", False):
+        from eventgpt_tpu.models.llama import fuse_llama_params
+
+        # Fuse BEFORE quantization so scales are computed on (and stream
+        # with) the fused tensors (models/llama.py:fuse_llama_params).
+        params["llama"] = fuse_llama_params(params["llama"])
     if args.quant in ("int8", "int4"):
         from eventgpt_tpu.ops.quant import quantize_llama_params
 
@@ -210,20 +227,45 @@ def prepare_model(cfg, params, tokenizer, args):
         )
     import jax.numpy as jnp
 
-    params = place_params(params, jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32)
+    jdt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    if mesh is not None:
+        from eventgpt_tpu.parallel.serving import shard_params_for_serving
+
+        # Host tree -> sharded placement directly: a 7B load never
+        # materializes an unsharded copy in HBM.
+        params = shard_params_for_serving(params, cfg, mesh, dtype=jdt)
+    else:
+        params = place_params(params, jdt)
     return cfg, params
+
+
+def serving_mesh_from_args(args):
+    """Mesh from --mesh_* flags; None for the single-chip fast path."""
+    from eventgpt_tpu.parallel.serving import build_serving_mesh
+
+    return build_serving_mesh(
+        data=getattr(args, "mesh_data", 1),
+        fsdp=getattr(args, "mesh_fsdp", 1),
+        model=getattr(args, "mesh_model", 1),
+    )
 
 
 def main(argv=None) -> str:
     args = build_parser().parse_args(argv)
     if args.num_beams < 1:
         raise ValueError(f"num_beams must be >= 1, got {args.num_beams}")
+    from eventgpt_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
 
     t0 = time.perf_counter()
     cfg, params, tokenizer = load_model(
         args.model_path, args.dtype, args.attn_impl, args.tokenizer_path
     )
-    cfg, params = prepare_model(cfg, params, tokenizer, args)
+    # One mesh per run: params, activations, and the KV cache must all be
+    # placed against the same Mesh object.
+    mesh = serving_mesh_from_args(args)
+    cfg, params = prepare_model(cfg, params, tokenizer, args, mesh=mesh)
     t_load = time.perf_counter() - t0
 
     t0 = time.perf_counter()
@@ -246,6 +288,7 @@ def main(argv=None) -> str:
         max_context=args.context_len,
         num_beams=args.num_beams,
         kv_quant=args.kv_cache == "int8",
+        mesh=mesh,
     )[0]
     t_gen = time.perf_counter() - t0
 

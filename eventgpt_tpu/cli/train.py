@@ -46,6 +46,9 @@ def _extract(args: argparse.Namespace, cls):
 
 
 def main(argv=None):
+    from eventgpt_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     logging.basicConfig(level=logging.INFO)
     parser = argparse.ArgumentParser(description="EventGPT-TPU trainer")
     for cls in (ModelArguments, DataArguments, TrainingArguments):

@@ -154,6 +154,45 @@ def _prefill_jit(params, cfg: EventChatConfig, embeds, mask, cache, last_only=Fa
     )
 
 
+@functools.lru_cache(maxsize=32)
+def _get_sharded_prefill(cfg: EventChatConfig, flat_sh, treedef, logits_sh):
+    """Serving-mesh prefill with pinned output shardings.
+
+    Without the pin, GSPMD is free to lay the written cache out differently
+    from the donated input cache, which silently breaks buffer aliasing —
+    a second full-size cache allocation per prefill (the donation warnings
+    the CPU-mesh tests would otherwise print). Keyed per (cfg, cache
+    shardings): one compile per serving configuration.
+    """
+    cache_sh = jax.tree_util.tree_unflatten(treedef, list(flat_sh))
+    return jax.jit(
+        lambda params, embeds, mask, cache: llama_mod.prefill(
+            params["llama"], cfg.llama, embeds, mask, cache, last_only=True
+        ),
+        donate_argnums=(3,),
+        out_shardings=(logits_sh, cache_sh),
+    )
+
+
+def _prefill_sharded(params, cfg: EventChatConfig, embeds, mask, cache, mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from eventgpt_tpu.parallel.serving import serving_batch_axes
+
+    cache_sh = jax.tree_util.tree_map(lambda x: x.sharding, cache)
+    flat, treedef = jax.tree_util.tree_flatten(cache_sh)
+    baxes = serving_batch_axes(mesh, embeds.shape[0])
+    model_n = mesh.shape.get("model", 1)
+    vocab_ax = (
+        "model"
+        if model_n > 1 and cfg.llama.vocab_size % model_n == 0
+        else None
+    )
+    logits_sh = NamedSharding(mesh, P(baxes if baxes else None, vocab_ax))
+    fn = _get_sharded_prefill(cfg, tuple(flat), treedef, logits_sh)
+    return fn(params, embeds, mask, cache)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
 def _decode_jit(params, cfg: EventChatConfig, tokens, cache):
     token_embeds = llama_mod.embed_tokens(params["llama"], tokens[:, None])
@@ -323,6 +362,7 @@ def generate(
     max_context: Optional[int] = None,
     num_beams: int = 1,
     kv_quant: bool = False,
+    mesh=None,
 ) -> List[List[int]]:
     """Autoregressive generation over a batch of event-QA prompts.
 
@@ -332,12 +372,40 @@ def generate(
     switches to deterministic length-normalized beam search (temperature /
     top_p are ignored, as with HF ``do_sample=False`` beam decoding).
 
+    ``mesh``: a serving ``Mesh`` (data/fsdp/model axes, context=1). Params
+    must already be placed by ``parallel.serving.shard_params_for_serving``;
+    this function shards the activations and KV cache to match, and the
+    existing jit units compile to one SPMD program (the BASELINE north-star
+    layout: pjit-sharded FSDP/TP weights, HBM-resident sharded cache —
+    vs the reference's single-GPU ``inference.py:52-63``).
+
     ``input_ids_batch``: token ids containing -200 sentinels.
     ``pixel_values_batch``: (B, T_frames, C, H, W).
     """
     from eventgpt_tpu.data.tokenizer import split_at_event
 
     compute_dtype = jax.tree_util.tree_leaves(params["llama"])[0].dtype
+
+    serving = None
+    if mesh is not None:
+        import dataclasses
+
+        from eventgpt_tpu.parallel import serving as serving_mod
+
+        serving = serving_mod
+        serving._require_serving_mesh(mesh)
+        if cfg.llama.attn_impl == "flash":
+            # The Pallas flash kernel is an opaque custom call to the SPMD
+            # partitioner — it would force an all-gather of every operand.
+            # Dense-scores prefill partitions cleanly (heads over model,
+            # batch over data/fsdp); prefill is one-shot, so the O(T^2)
+            # score materialization is not on the decode hot path.
+            cfg = dataclasses.replace(
+                cfg, llama=dataclasses.replace(cfg.llama, attn_impl="dense")
+            )
+        pixel_values_batch = serving.shard_batch_array(
+            pixel_values_batch, mesh, compute_dtype
+        )
 
     event_tokens = encode_events_batch(
         params, cfg, jnp.asarray(pixel_values_batch, dtype=compute_dtype)
@@ -355,10 +423,19 @@ def generate(
     cache = llama_mod.init_kv_cache(
         cfg.llama, b, max_len, dtype=compute_dtype, quant=kv_quant
     )
+    if serving is not None:
+        padded = serving.shard_batch_array(padded, mesh)
+        mask = serving.shard_batch_array(mask, mesh)
+        cache = serving.shard_kv_cache(cache, cfg.llama, mesh)
 
-    last_logits, cache = _prefill_jit(params, cfg, padded, mask, cache, True)
+    if serving is not None:
+        last_logits, cache = _prefill_sharded(params, cfg, padded, mask, cache, mesh)
+    else:
+        last_logits, cache = _prefill_jit(params, cfg, padded, mask, cache, True)
 
     key = jax.random.PRNGKey(seed)
+    if serving is not None:
+        key = serving.replicate(key, mesh)
     if max_new_tokens == 0:
         return [[] for _ in range(b)]
     # EOS sentinel: a real id stops rows early; None decodes the full budget

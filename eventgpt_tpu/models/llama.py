@@ -133,20 +133,25 @@ def fuse_llama_params(params: Params) -> Params:
     fused tensor and stream with it). Not for training: LoRA targets
     address the unfused names.
     """
+    import numpy as np
+
     layers = params["layers"]
     attn, mlp = layers["attn"], layers["mlp"]
+    # Host (numpy) trees fuse on host — a jnp.concatenate here would pull
+    # the whole 7B tree onto the device before quantization/sharding.
+    xp = jnp if isinstance(attn["q"], jax.Array) else np
     fused = {
         **params,
         "layers": {
             **layers,
             "attn": {
-                "qkv": jnp.concatenate(
+                "qkv": xp.concatenate(
                     [attn["q"], attn["k"], attn["v"]], axis=-1
                 ),
                 "o": attn["o"],
             },
             "mlp": {
-                "gate_up": jnp.concatenate(
+                "gate_up": xp.concatenate(
                     [mlp["gate"], mlp["up"]], axis=-1
                 ),
                 "down": mlp["down"],

@@ -1,0 +1,204 @@
+"""Mesh-sharded serving: parameter + KV-cache placement for ``generate``.
+
+The reference serves its frozen LLM on one GPU — torch module + HF generate
+(``inference.py:28-66``, ``model/EventChatModel.py:237-276``). The BASELINE
+north star is the same surface over a pod: HF weights loaded into a
+pjit-sharded FSDP/TP layout with the KV cache resident in HBM. This module
+is the serving half of ``parallel/sharding.py``: it places an EventChat
+param tree (plain, int8, int4 or LoRA-composite leaves) and a KV cache onto
+a ``Mesh`` so the existing jit'd prefill/decode units compile to one SPMD
+program — computation follows data, XLA inserts the collectives (fsdp
+all-gathers, model-axis psums).
+
+Layout decisions specific to serving:
+
+  * Params reuse the training specs (``eventchat_param_specs``): matmul
+    contraction dims over ``fsdp`` (ZeRO-style, gathered at use), head /
+    column dims over ``model`` (megatron TP, one psum per layer).
+  * Quantized leaves shard their int payload exactly like the bf16 weight
+    they replace; the per-channel scales replicate over the contraction
+    axis (they are 1/256th of the payload — sharding them buys nothing and
+    the size-1 / group dims do not always divide the axis).
+  * The KV cache shards batch over whatever prefix of ``(data, fsdp)``
+    divides the run's batch (pure-TP fallback for batch 1) and KV heads
+    over ``model`` — decode reads the cache in place, no resharding per
+    step.
+  * ``context`` must be 1: sequence parallelism is a prefill-side
+    optimization (ring/ulysses in ``parallel/ring.py``/``ulysses.py``)
+    whose value is long-context *training*; serving prompts sit far below
+    the 2048 context cap and the decode hot loop attends to the whole
+    cache from a single query token.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from eventgpt_tpu.ops import quant as quant_mod
+from eventgpt_tpu.parallel.sharding import eventchat_param_specs
+
+
+def _scale_spec(spec: P) -> P:
+    """Spec for a quantization-scale leaf: same rank as the weight spec with
+    the contraction (second-to-last) axis replicated — int8 scales have a
+    size-1 dim there, int4 group counts need not divide ``fsdp``."""
+    parts = list(spec) + [None] * 0
+    if len(parts) >= 2:
+        parts[-2] = None
+    return P(*parts)
+
+
+def _put(x, mesh: Mesh, spec: P, dtype=None):
+    arr = jnp.asarray(x) if dtype is None else jnp.asarray(x, dtype)
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def _shard_tree(tree: Any, spec: Any, mesh: Mesh, dtype) -> Any:
+    """Recursive quant-aware placement. ``spec`` mirrors ``tree`` except at
+    composite leaves ({"q","s"} / {"q4","s"} / {"w","a","b"}), where one
+    PartitionSpec covers the whole composite."""
+    if quant_mod.is_quantized(tree):
+        return {"q": _put(tree["q"], mesh, spec),
+                "s": _put(tree["s"], mesh, _scale_spec(spec), jnp.float32)}
+    if quant_mod.is_quantized4(tree):
+        return {"q4": _put(tree["q4"], mesh, spec),
+                "s": _put(tree["s"], mesh, _scale_spec(spec), jnp.float32)}
+    if quant_mod.is_lora(tree):
+        rep = P(*([None] * (len(spec) if spec else 0)))
+        return {"w": _shard_tree(tree["w"], spec, mesh, dtype),
+                "a": _put(tree["a"], mesh, rep, dtype),
+                "b": _put(tree["b"], mesh, rep, dtype)}
+    if isinstance(tree, dict):
+        return {k: _shard_tree(v, spec[k], mesh, dtype) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(
+            _shard_tree(v, s, mesh, dtype) for v, s in zip(tree, spec)
+        )
+    return _put(tree, mesh, spec, dtype)
+
+
+def shard_params_for_serving(
+    params: Any,
+    cfg,
+    mesh: Mesh,
+    dtype=None,
+) -> Any:
+    """Place an EventChat param tree on ``mesh`` under the serving layout.
+
+    Accepts host (numpy) or device trees — host trees go straight to their
+    sharded placement, so a 7B load never materializes an unsharded copy in
+    HBM. ``dtype`` casts float leaves (quantized payloads/scales keep their
+    storage types).
+    """
+    _require_serving_mesh(mesh)
+    specs = eventchat_param_specs(
+        cfg.projector.use_feature_adaptor,
+        cfg.projector.mlp_depth,
+        use_qformer="qformer" in params,
+    )
+    _adapt_fused_llama_specs(specs["llama"], params["llama"])
+    return {k: _shard_tree(v, specs[k], mesh, dtype) for k, v in params.items()}
+
+
+def _adapt_fused_llama_specs(llama_specs: Any, llama_params: Any) -> None:
+    """``fuse_llama_params`` merges q|k|v and gate|up leaves; the fused
+    column dim shards over ``model`` exactly like the unfused columns did
+    (GSPMD reshards the post-matmul slice boundaries as needed)."""
+    attn = llama_params["layers"]["attn"]
+    if "qkv" in attn:
+        llama_specs["layers"]["attn"] = {
+            "qkv": P(None, "fsdp", "model"),
+            "o": P(None, "model", "fsdp"),
+        }
+    if "gate_up" in llama_params["layers"]["mlp"]:
+        llama_specs["layers"]["mlp"] = {
+            "gate_up": P(None, "fsdp", "model"),
+            "down": P(None, "model", "fsdp"),
+        }
+
+
+def _require_serving_mesh(mesh: Mesh) -> None:
+    if "context" in mesh.shape and mesh.shape["context"] > 1:
+        raise ValueError(
+            "serving meshes must have context=1 (sequence parallelism is a "
+            "long-context training optimization; decode attends to the full "
+            "cache from one query token)"
+        )
+
+
+def serving_batch_axes(mesh: Mesh, batch: int) -> Tuple[str, ...]:
+    """Largest prefix of ``(data, fsdp)`` whose size product divides
+    ``batch`` — batch 1 on a wide mesh degrades to pure TP + weight
+    gathering instead of failing on an unshardable batch dim."""
+    axes = []
+    prod = 1
+    for ax in ("data", "fsdp"):
+        n = mesh.shape.get(ax, 1)
+        if n > 1 and batch % (prod * n) == 0:
+            axes.append(ax)
+            prod *= n
+    return tuple(axes)
+
+
+def batch_sharding(mesh: Mesh, batch: int, ndim: int) -> NamedSharding:
+    axes = serving_batch_axes(mesh, batch)
+    return NamedSharding(mesh, P(axes if axes else None, *([None] * (ndim - 1))))
+
+
+def shard_batch_array(x, mesh: Mesh, dtype=None):
+    """Place a (B, ...) activation with batch over the serving batch axes."""
+    arr = jnp.asarray(x) if dtype is None else jnp.asarray(x, dtype)
+    return jax.device_put(arr, batch_sharding(mesh, arr.shape[0], arr.ndim))
+
+
+def replicate(x, mesh: Mesh):
+    arr = jnp.asarray(x)
+    return jax.device_put(arr, NamedSharding(mesh, P(*([None] * arr.ndim))))
+
+
+def shard_kv_cache(cache: Any, cfg, mesh: Mesh) -> Any:
+    """Place a fresh KV cache: (L, B, S, KV, hd) with batch over the serving
+    batch axes and KV heads over ``model`` (skipped if it does not divide
+    the head count). ``length`` (B,) shards with the batch."""
+    quant = isinstance(cache["k"], dict)
+    batch = int(
+        (cache["k"]["q"] if quant else cache["k"]).shape[1]
+    )
+    baxes = serving_batch_axes(mesh, batch)
+    bspec = baxes if baxes else None
+    model_n = mesh.shape.get("model", 1)
+    head_ax = "model" if (model_n > 1 and cfg.num_kv_heads % model_n == 0) else None
+    buf_spec = P(None, bspec, None, head_ax, None)
+
+    def put_buf(buf):
+        if isinstance(buf, dict):
+            return {"q": _put(buf["q"], mesh, buf_spec),
+                    "s": _put(buf["s"], mesh, buf_spec)}
+        return _put(buf, mesh, buf_spec)
+
+    return {
+        "k": put_buf(cache["k"]),
+        "v": put_buf(cache["v"]),
+        "length": _put(cache["length"], mesh, P(bspec)),
+    }
+
+
+def build_serving_mesh(
+    data: int = 1, fsdp: int = 1, model: int = 1,
+    devices: Optional[list] = None,
+) -> Optional[Mesh]:
+    """CLI helper: mesh from --mesh_* flags; None when everything is 1
+    (single-chip fast path, no resharding)."""
+    if data * fsdp * model <= 1:
+        return None
+    from eventgpt_tpu.config import MeshConfig
+    from eventgpt_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(
+        MeshConfig(data=data, fsdp=fsdp, context=1, model=model),
+        devices=devices,
+    )

@@ -49,6 +49,9 @@ def synthesize_stream(tmp_dir: str) -> str:
 
 
 def main(argv=None):
+    from eventgpt_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     p = argparse.ArgumentParser(description="Streaming event-QA demo")
     p.add_argument("--events", type=str, default=None,
                    help="txt ('t x y p') or structured npy stream")

@@ -1,0 +1,151 @@
+"""Load-back proof against the ACTUAL reference stack (VERDICT r2 missing #3).
+
+``cli/export.py`` writes reference-layout checkpoints; until something
+loads one with the real ``EventChatModel.from_pretrained``
+(``/root/reference/model/EventChatModel.py:431-432``) and generates from
+it, interop is asserted rather than demonstrated. This test exports a tiny
+checkpoint, imports the reference package (torch CPU), loads it through
+``AutoConfig`` + ``from_pretrained`` exactly like ``inference.py:28-30``,
+and requires greedy tokens to match this framework's ``generate``.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+REF = "/root/reference"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not available")
+def test_reference_from_pretrained_loads_export_and_matches_greedy(tmp_path):
+    pytest.importorskip("peft")
+    transformers = pytest.importorskip("transformers")
+    import jax
+
+    from eventgpt_tpu.config import (
+        EventChatConfig, LlamaConfig, ProjectorConfig, VisionConfig,
+    )
+    from eventgpt_tpu.models import eventchat
+    from eventgpt_tpu.models.convert import (
+        eventchat_params_to_hf, write_hf_checkpoint,
+    )
+
+    # The reference hardcodes the projector/adaptor widths — 1024-dim CLIP
+    # features into a 4096-dim LM (EventChatModel.py:67-69) — regardless of
+    # the checkpoint config, so an interop checkpoint is necessarily
+    # 1024->4096. Single layers keep the test tractable on CPU.
+    cfg = EventChatConfig(
+        vision=VisionConfig(hidden_size=1024, intermediate_size=128,
+                            num_layers=1, num_heads=8, image_size=28,
+                            patch_size=14),
+        llama=LlamaConfig(vocab_size=256, hidden_size=4096,
+                          intermediate_size=256, num_layers=1, num_heads=8,
+                          num_kv_heads=8, max_seq_len=256),
+        projector=ProjectorConfig(input_dim=1024, output_dim=4096),
+    )
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+
+    # Local tiny CLIP tower dir: VisualTower.__init__ resolves the tower +
+    # image processor by name (EventChatModel.py:50-51); a local directory
+    # keeps the test offline. Weights don't matter here — from_pretrained
+    # overrides them with the exported state dict.
+    from transformers import CLIPImageProcessor, CLIPVisionConfig, CLIPVisionModel
+
+    tower_dir = str(tmp_path / "tower")
+    clip_cfg = CLIPVisionConfig(
+        hidden_size=cfg.vision.hidden_size,
+        intermediate_size=cfg.vision.intermediate_size,
+        num_hidden_layers=cfg.vision.num_layers,
+        num_attention_heads=cfg.vision.num_heads,
+        image_size=cfg.vision.image_size,
+        patch_size=cfg.vision.patch_size,
+        projection_dim=cfg.vision.hidden_size,
+    )
+    CLIPVisionModel(clip_cfg).save_pretrained(tower_dir)
+    CLIPImageProcessor(
+        size={"shortest_edge": cfg.vision.image_size},
+        crop_size={"height": cfg.vision.image_size, "width": cfg.vision.image_size},
+    ).save_pretrained(tower_dir)
+
+    out_dir = str(tmp_path / "export")
+    write_hf_checkpoint(params, cfg, out_dir, visual_tower=tower_dir)
+
+    sys.path.insert(0, REF)
+    try:
+        try:
+            # Registers EventChat_llama with AutoConfig/AutoModel on import.
+            from model.EventChatModel import EventChatModel
+        except Exception as e:  # pragma: no cover - env-dependent
+            pytest.skip(f"reference stack not importable: {e}")
+
+        from transformers import AutoConfig
+
+        config = AutoConfig.from_pretrained(out_dir)
+        model = EventChatModel.from_pretrained(
+            out_dir, torch_dtype=torch.float32, config=config
+        )
+        # VisualTower hard-codes bf16 (EventChatModel.py:51), which would
+        # round the tower away from this framework's f32 run; normalize to
+        # f32 and reload the exported tower weights so the comparison
+        # isolates load/generate mechanics, not dtype policy.
+        model = model.float().eval()
+        sd = eventchat_params_to_hf(
+            jax.tree_util.tree_map(np.asarray, params), cfg
+        )
+        tower_prefix = "model.visual_tower.visual_tower."
+        tower_sd = {
+            k[len(tower_prefix):]: torch.from_numpy(np.ascontiguousarray(v))
+            for k, v in sd.items() if k.startswith(tower_prefix)
+        }
+        missing, unexpected = (
+            model.get_visual_tower().visual_tower.load_state_dict(
+                tower_sd, strict=False
+            )
+        )
+        assert not unexpected, unexpected
+
+        rng = np.random.default_rng(0)
+        pixels = rng.normal(
+            size=(1, cfg.num_event_frames, 3, cfg.vision.image_size,
+                  cfg.vision.image_size)
+        ).astype(np.float32)
+        ids = [1, 5, 9, -200, 17, 23]
+
+        ours = eventchat.generate(
+            params, cfg, [ids], pixels, max_new_tokens=8, temperature=0.0,
+            eos_token_id=2,
+        )[0]
+
+        # inference.py:50 feeds a LIST of per-frame tensors -> the
+        # per-frame encode + adaptor + spatio-temporal pool path.
+        ev_list = [torch.from_numpy(pixels[0, t])
+                   for t in range(cfg.num_event_frames)]
+        inp = torch.tensor([ids], dtype=torch.long)
+        with torch.inference_mode():
+            out_ids = model.generate(
+                inp,
+                event_tensors=ev_list,
+                event_image_sizes=[[cfg.vision.image_size,
+                                    cfg.vision.image_size]],
+                do_sample=False,
+                max_new_tokens=8,
+                use_cache=True,
+            )
+        theirs = out_ids[0].tolist()
+        if theirs and theirs[-1] == 2:
+            theirs = theirs[:-1]  # this framework's generate strips EOS
+        assert theirs == ours
+    finally:
+        sys.path.remove(REF)
+        # The reference package shadows nothing in this repo, but leaving
+        # its modules cached would let a later import of `model.*` resolve
+        # against a dead sys.path entry.
+        for name in [m for m in sys.modules
+                     if m == "model" or m.startswith("model.")
+                     or m == "dataset" or m.startswith("dataset.")
+                     or m == "common" or m.startswith("common.")]:
+            del sys.modules[name]
