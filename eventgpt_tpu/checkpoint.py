@@ -111,17 +111,20 @@ def find_latest_checkpoint(output_dir: str) -> Optional[str]:
     last durable state — the TPU-era replacement for the reference stack's
     (absent) recovery story, SURVEY.md §5 "Failure detection".
 
-    Ordering: the STEP NUMBER in the name is the primary key
-    (``ckpt_step{N}``; ``ckpt_preempt_step{N}`` wins a tie at the same N
-    since preemption strikes after the periodic save). mtime is only the
-    arbiter for the unnumbered names ``ckpt_last`` / legacy
-    ``ckpt_preempt`` — it must never order step checkpoints, because
-    directory mtimes are synthetic on gcsfuse-style filesystems and lost by
-    rsync, and resuming from a mis-ordered step save silently discards
-    training. Only COMPLETED checkpoint names are eligible: orbax writes
-    in-progress saves to a sibling ``*.orbax-checkpoint-tmp-*`` directory,
-    and a run killed mid-save must not hand that half-written state to the
-    relaunch.
+    Ordering: the RECORDED STEP is the primary key — every save writes a
+    ``STEP`` file inside the checkpoint dir (``trainer.save``), and for
+    older dirs without one the ``ckpt_step{N}`` name supplies it
+    (``ckpt_preempt_step{N}`` wins a tie at the same N since preemption
+    strikes after the periodic save). mtime is only the arbiter BETWEEN
+    checkpoints with no recorded step at all (legacy ``ckpt_last`` /
+    ``ckpt_preempt``), and those never beat a step-recorded checkpoint —
+    directory mtimes are synthetic on gcsfuse-style filesystems, fabricated
+    by rsync/copies (ADVICE r2: a copied stale ckpt_last with a fresh mtime
+    must not silently discard training), and resuming from a mis-ordered
+    save silently loses work. Only COMPLETED checkpoint names are eligible:
+    orbax writes in-progress saves to a sibling
+    ``*.orbax-checkpoint-tmp-*`` directory, and a run killed mid-save must
+    not hand that half-written state to the relaunch.
     """
     import re
 
@@ -134,24 +137,44 @@ def find_latest_checkpoint(output_dir: str) -> Optional[str]:
         except OSError:
             return 0.0
 
-    best_step = (-1, -1, None)  # (step, preempt-tiebreak, path)
-    unnumbered = []
+    def recorded_step(p):
+        try:
+            with open(os.path.join(p, "STEP")) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    best_step = (-1, -1, None)  # (step, name-rank tiebreak, path)
+    stepless = []
     for name in os.listdir(output_dir):
         path = os.path.join(output_dir, name)
         if not os.path.isdir(path):
             continue
         m = re.fullmatch(r"ckpt_(preempt_)?step(\d+)", name)
-        if m:
-            key = (int(m.group(2)), 1 if m.group(1) else 0, path)
-            if key[:2] > best_step[:2]:
-                best_step = key
-        elif re.fullmatch(r"ckpt_(last|preempt)", name):
-            unnumbered.append(path)
-    best = best_step[2]
-    for path in unnumbered:
-        # Strict >: an mtime TIE (coarse/synthetic filesystem timestamps)
-        # must go to the step-numbered checkpoint — a stale ckpt_last from
-        # an older incarnation must never beat a newer step save.
+        named = re.fullmatch(r"ckpt_(last|preempt)", name)
+        if not (m or named):
+            continue
+        step = recorded_step(path)
+        if step is None and m:
+            step = int(m.group(2))
+        # Equal-step tiebreak by write order within a run: the preemption
+        # save lands after the periodic save, and ckpt_last is a completed
+        # run's final save after its last ckpt_step.
+        if (m and m.group(1)) or name == "ckpt_preempt":
+            rank = 2
+        elif name == "ckpt_last":
+            rank = 1
+        else:
+            rank = 0
+        if step is not None:
+            if (step, rank) > best_step[:2]:
+                best_step = (step, rank, path)
+        else:
+            stepless.append(path)
+    if best_step[2] is not None:
+        return best_step[2]
+    best = None
+    for path in stepless:
         if best is None or mtime(path) > mtime(best):
             best = path
     return best

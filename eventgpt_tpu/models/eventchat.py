@@ -256,7 +256,8 @@ def _decode_loop_jit(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "num_beams", "max_new_tokens", "eos_token_id"),
+    static_argnames=("cfg", "num_beams", "max_new_tokens", "eos_token_id",
+                     "gather_start"),
     # No cache donation: the first op repeats the cache to num_beams x its
     # size, so the donated buffers could never be reused anyway (XLA would
     # just warn on every call).
@@ -269,6 +270,7 @@ def _beam_loop_jit(
     num_beams: int,
     max_new_tokens: int,
     eos_token_id: int,
+    gather_start: int = 0,
 ):
     """On-device deterministic beam search (length-normalized, HF
     ``length_penalty=1.0`` semantics): cumulative log-prob divided by the
@@ -278,6 +280,12 @@ def _beam_loop_jit(
     (``inference.py:22``, default 1). Beams live as an expanded batch
     (B*num_beams rows) over the same decode_step; each iteration re-gathers
     the KV cache rows by parent-beam index.
+
+    ``gather_start`` bounds that regather (VERDICT r2 weak #4): slots below
+    the shortest prompt length are byte-identical across beams (repeated
+    from one prefill row, decode writes only at slot >= prompt length), so
+    each step permutes just the tail ``[gather_start, S)`` — copy traffic
+    O(L*B*k*(S - gather_start)) per token instead of O(L*B*k*S).
 
     Returns (tokens [B, max_new_tokens] of the best beam, lengths [B]).
     """
@@ -330,7 +338,12 @@ def _beam_loop_jit(
         done = par_done | (tok == eos_token_id)
 
         flat_parent = (rows * k + parent).reshape(-1)
-        sel = lambda t: jax.tree_util.tree_map(lambda x: x[:, flat_parent], t)
+        sel = lambda t: jax.tree_util.tree_map(
+            lambda x: x.at[:, :, gather_start:].set(
+                x[:, flat_parent, gather_start:]
+            ),
+            t,
+        )
         cache = {
             "k": sel(cache["k"]),
             "v": sel(cache["v"]),
@@ -442,9 +455,13 @@ def generate(
     # (an out-of-vocab sentinel that never matches a sampled token).
     eos = eos_token_id if eos_token_id is not None else -1
     if num_beams > 1:
+        # Bucketed down (same 64-grain as the cache length): gather_start is
+        # a STATIC jit arg, and an exact lens.min() would recompile the
+        # whole beam loop per distinct prompt length.
         tokens, lengths = _beam_loop_jit(
             params, cfg, last_logits, cache, int(num_beams),
             max_new_tokens, int(eos),
+            gather_start=(int(lens.min()) // 64) * 64,
         )
         out_tokens = np.asarray(jax.device_get(tokens))
         out_lengths = np.asarray(jax.device_get(lengths))

@@ -204,6 +204,11 @@ def _attn_block(cfg: LlamaConfig, q_proj: jnp.ndarray, layer: Params,
 
     q = q_proj.reshape(b, q_len, h, hd)
     q = apply_rope(q, cos, sin)
+    if ring_fn is not None and getattr(ring_fn, "accepts_unrepeated_kv", False):
+        # Ulysses repeats GQA heads AFTER its all-to-all — the exchange
+        # moves KV-count bytes, not H-count (ADVICE r2).
+        ctx = ring_fn(q, k_full, v_full, valid, valid).reshape(b, q_len, h * hd)
+        return _mm(ctx, layer["attn"]["o"])
     k = _repeat_kv(k_full, h // kvh)
     v = _repeat_kv(v_full, h // kvh)
 

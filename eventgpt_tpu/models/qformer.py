@@ -268,6 +268,19 @@ def qformer_config_from_artifacts(
         num_layers = max(idxs) + 1
     if heads is None:
         heads = next(h for h in (8, 4, 2, 1) if hidden % h == 0)
+        import logging
+
+        # A Q-Former trained with a different split would silently compute
+        # different attention at serve time (ADVICE r2) — make the guess
+        # loud; metadata-carrying artifacts (qformer_meta.num_heads) never
+        # hit this path.
+        logging.getLogger("eventgpt_tpu.qformer").warning(
+            "attention_layers artifact carries no qformer_meta.num_heads; "
+            "GUESSING num_heads=%d from hidden=%d — re-export the artifact "
+            "with this framework (metadata included) or verify the trained "
+            "head count matches",
+            heads, hidden,
+        )
     return QFormerConfig(num_queries=num_queries, num_layers=num_layers,
                          num_heads=heads, hidden_size=hidden,
                          mlp_ratio=mlp_ratio)

@@ -50,32 +50,29 @@ def _int4_kernel(xe_ref, xo_ref, w_ref, s_ref, out_ref, *, half_group: int,
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    # Offset-binary nibbles -> centered int -> bf16. Bit ops run at i32
+    # Offset-binary nibbles -> centered int -> bf16 (exact: int4 values are
+    # integers <= 8, representable in bf16 losslessly). Bit ops run at i32
     # (Mosaic cannot legalize sub-word shifts: 'arith.shrui' on vector<i8>).
     w = w_ref[:].astype(jnp.int32)
     bkp, bn = w.shape
-    hi = ((w >> 4) - 8).astype(jnp.bfloat16)
-    lo = ((w & 0xF) - 8).astype(jnp.bfloat16)
-    # Expand this step's group scales to per-packed-row: logical rows 2r and
-    # 2r+1 share the group of packed row r, so one expansion serves both
-    # planes.
     gb = groups_per_step
-    s = jnp.broadcast_to(
-        s_ref[:].astype(jnp.bfloat16)[:, None, :],
-        (gb, half_group, bn),
-    ).reshape(bkp, bn)
-    hi = hi * s
-    lo = lo * s
+    hi = ((w >> 4) - 8).astype(jnp.bfloat16).reshape(gb, half_group, bn)
+    lo = ((w & 0xF) - 8).astype(jnp.bfloat16).reshape(gb, half_group, bn)
 
-    acc = jax.lax.dot_general(
-        xe_ref[:], hi, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    acc += jax.lax.dot_general(
-        xo_ref[:], lo, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    out_ref[:] += acc
+    # f32 group scales applied to f32 per-group dot partials — numerically
+    # IDENTICAL to the XLA fallback (ops/quant.py:_matmul4). The previous
+    # form pre-scaled bf16 nibbles by bf16-cast scales: two roundings whose
+    # error depended on shape alignment (kernel vs fallback divergence,
+    # ADVICE r2). The MXU still sees pure-integer bf16 operands.
+    b = xe_ref.shape[0]
+    xe = jnp.swapaxes(xe_ref[:].reshape(b, gb, half_group), 0, 1)  # (gb,B,hg)
+    xo = jnp.swapaxes(xo_ref[:].reshape(b, gb, half_group), 0, 1)
+    dims = (((2,), (1,)), ((0,), (0,)))
+    part = jax.lax.dot_general(xe, hi, dims,
+                               preferred_element_type=jnp.float32)
+    part += jax.lax.dot_general(xo, lo, dims,
+                                preferred_element_type=jnp.float32)
+    out_ref[:] += jnp.sum(part * s_ref[:][:, None, :], axis=0)
 
 
 def supported(k: int, n: int, group: int) -> bool:

@@ -164,6 +164,19 @@ def _matmul4(x: jnp.ndarray, leaf: QuantizedLeaf) -> jnp.ndarray:
     return y.reshape(*lead, n)
 
 
+def _lora_branch_input(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """Adapter-branch input, with inverted dropout when the composite leaf
+    carries per-layer mask state (``train/lora.py:apply_lora`` with a step
+    key). peft semantics: only the A@B branch sees the dropped input."""
+    if "k" not in w:
+        return x
+    import jax
+
+    keep = 1.0 - w["dr"]
+    mask = jax.random.bernoulli(w["k"], keep, x.shape)
+    return jnp.where(mask, x / keep.astype(x.dtype), jnp.zeros((), x.dtype))
+
+
 def matmul(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     """x @ w for a plain or quantized weight leaf.
 
@@ -172,7 +185,8 @@ def matmul(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     output, preserving the dense path's f32 accumulation.
     """
     if is_lora(w):
-        delta = jnp.matmul(x, w["a"].astype(x.dtype)) @ w["b"].astype(x.dtype)
+        xl = _lora_branch_input(x, w)
+        delta = jnp.matmul(xl, w["a"].astype(x.dtype)) @ w["b"].astype(x.dtype)
         return matmul(x, w["w"]) + delta
     if is_quantized4(w):
         return _matmul4(x, w).astype(x.dtype)
@@ -187,7 +201,8 @@ def matmul(x: jnp.ndarray, w: Any) -> jnp.ndarray:
 def matmul_f32_out(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     """Like ``matmul`` but returns the f32 accumulator (lm_head logits)."""
     if is_lora(w):
-        delta = jnp.matmul(x, w["a"].astype(x.dtype)) @ w["b"].astype(x.dtype)
+        xl = _lora_branch_input(x, w)
+        delta = jnp.matmul(xl, w["a"].astype(x.dtype)) @ w["b"].astype(x.dtype)
         return matmul_f32_out(x, w["w"]) + delta.astype(jnp.float32)
     if is_quantized4(w):
         return _matmul4(x, w)

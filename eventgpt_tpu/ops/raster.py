@@ -47,10 +47,81 @@ def check_event_stream_length(start_time_us: int, end_time_us: int,
         )
 
 
+class _NumpyOnlyUnpickler:
+    """Restricted unpickler for legacy event files: only the globals numpy
+    needs to rebuild ``{str: ndarray}`` dicts resolve; anything else (the
+    arbitrary-code-execution surface of ``allow_pickle=True``) raises.
+
+    The reference loads event .npy with ``allow_pickle=True``
+    (``common/common.py:111-112``) and its published samples ARE pickled
+    object arrays — refusing them outright would break the reference's own
+    inputs, so the fix is to make the pickle path safe rather than gated.
+    """
+
+    _ALLOWED = {
+        ("numpy.core.multiarray", "_reconstruct"),
+        ("numpy._core.multiarray", "_reconstruct"),
+        ("numpy.core.multiarray", "scalar"),
+        ("numpy._core.multiarray", "scalar"),
+        ("numpy", "ndarray"),
+        ("numpy", "dtype"),
+    }
+
+    def __new__(cls, fp):
+        import pickle
+
+        class _U(pickle.Unpickler):
+            def find_class(self, module, name):
+                if (module, name) in cls._ALLOWED:
+                    return super().find_class(module, name)
+                raise pickle.UnpicklingError(
+                    f"blocked pickle global {module}.{name} in event file "
+                    f"(only numpy array payloads are allowed)"
+                )
+
+        return _U(fp)
+
+
+def _load_legacy_pickled_events(path: str) -> EventDict:
+    """Read a legacy object-array .npy through the restricted unpickler.
+
+    Parses the npy header with numpy's format module, then unpickles the
+    payload with ``_NumpyOnlyUnpickler`` instead of ``np.load``'s
+    unrestricted ``pickle.load``.
+    """
+    from numpy.lib import format as npf
+
+    with open(path, "rb") as f:
+        version = npf.read_magic(f)
+        npf._check_version(version)
+        _shape, _fortran, dtype = npf._read_array_header(f, version)
+        if not dtype.hasobject:
+            raise ValueError(f"{path}: not an object-array npy")
+        obj = _NumpyOnlyUnpickler(f).load()
+    d = np.array(obj).item() if isinstance(obj, np.ndarray) else obj
+    if not isinstance(d, dict):
+        raise ValueError(f"{path}: expected an event dict, got {type(d)}")
+    return {str(k): np.asarray(v) for k, v in d.items()}
+
+
 def load_event_npy(path: str) -> EventDict:
-    """Load a ``{x,y,t,p}`` dict from an .npy file (``common/common.py:111-112``)."""
-    raw = np.load(path, allow_pickle=True)
-    return dict(np.array(raw).item())
+    """Load a ``{x,y,t,p}`` dict from an .npy file (``common/common.py:111-112``).
+
+    Plain structured arrays (this framework's native stream format, e.g.
+    ``scripts/stream_demo.py``) load without pickle; legacy pickled dict
+    files (the reference's samples) go through a restricted unpickler that
+    only admits numpy reconstruction globals — never ``allow_pickle=True``.
+    """
+    try:
+        raw = np.load(path)  # no pickle: safe structured-array path
+    except ValueError:
+        return _load_legacy_pickled_events(path)
+    if raw.dtype.names:
+        return {n: np.ascontiguousarray(raw[n]) for n in raw.dtype.names}
+    raise ValueError(
+        f"{path}: unsupported event npy layout (expected a structured "
+        f"array with named fields or a legacy pickled dict)"
+    )
 
 
 def rasterize_events(

@@ -100,6 +100,11 @@ def shard_params_for_serving(
         cfg.projector.mlp_depth,
         use_qformer="qformer" in params,
     )
+    from eventgpt_tpu.parallel.sharding import vocab_safe_llama_specs
+
+    emb = params["llama"]["embed_tokens"]
+    vocab = int((emb["q"] if isinstance(emb, dict) else emb).shape[0])
+    vocab_safe_llama_specs(specs["llama"], vocab, mesh)
     _adapt_fused_llama_specs(specs["llama"], params["llama"])
     return {k: _shard_tree(v, specs[k], mesh, dtype) for k, v in params.items()}
 

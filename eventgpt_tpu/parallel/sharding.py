@@ -135,6 +135,23 @@ def qformer_param_specs() -> Specs:
     }
 
 
+def vocab_safe_llama_specs(llama_specs: Specs, vocab_size: int,
+                           mesh: Mesh) -> Specs:
+    """Drop the vocab-dim ``model`` sharding when it cannot divide.
+
+    Special-token registration grows the vocab to odd sizes (32000 ->
+    32003, ``initialize_vision_tokenizer`` parity), and ``device_put``
+    rejects non-divisible tilings outright — replicating the vocab dim of
+    embed/lm_head (features keep their fsdp sharding) trades a little
+    memory for a working TP layout. Returns the (mutated) spec tree.
+    """
+    model_n = mesh.shape.get("model", 1)
+    if model_n > 1 and vocab_size % model_n:
+        llama_specs["embed_tokens"] = P(None, "fsdp")
+        llama_specs["lm_head"] = P("fsdp", None)
+    return llama_specs
+
+
 def eventchat_param_specs(use_feature_adaptor: bool = True, mlp_depth: int = 2,
                           use_qformer: bool = False) -> Specs:
     specs = {

@@ -13,9 +13,14 @@ Merging inside jit keeps the base weights frozen (no gradient flows to them:
 they enter only as constants) while XLA fuses the rank-r update into the
 surrounding matmuls. This replaces peft's module-wrapping with two einsums.
 
-Note: merge-form LoRA cannot express per-call input dropout; ``lora_dropout``
-is accepted for config parity but must be 0 here (the reference's inference
-path also runs with dropout disabled).
+``lora_dropout`` (peft semantics: dropout on the adapter-branch INPUT, the
+base path undropped — ``y = x@W + dropout(x)@A@B*scale``) is implemented in
+apply-form: ``apply_lora`` given a step key attaches per-layer PRNG keys to
+each composite leaf, stacked on the layer axis so the layer ``lax.scan``
+slices them alongside A/B, and the matmul dispatch (``ops/quant.py``)
+draws the mask inside the jitted step. Serving and eval never pass a key,
+so the adapted model is deterministic there — matching peft modules in
+``.eval()`` mode.
 """
 
 from __future__ import annotations
@@ -48,7 +53,7 @@ DEFAULT_TARGETS: Tuple[str, ...] = ("q", "k", "v", "o", "gate", "up", "down")
 @dataclass(frozen=True)
 class LoraConfig:
     """Defaults follow the recovered TrainingArguments (SURVEY.md §2.2) /
-    peft conventions: r=64, alpha=16, dropout accepted-but-zero."""
+    peft conventions: r=64, alpha=16, dropout=0."""
 
     r: int = 64
     alpha: float = 16.0
@@ -56,10 +61,8 @@ class LoraConfig:
     targets: Tuple[str, ...] = DEFAULT_TARGETS
 
     def __post_init__(self):
-        if self.dropout != 0.0:
-            raise NotImplementedError(
-                "merge-form LoRA runs with dropout=0; see module docstring"
-            )
+        if not 0.0 <= self.dropout < 1.0:
+            raise ValueError(f"lora dropout must be in [0, 1), got {self.dropout}")
 
     @property
     def scaling(self) -> float:
@@ -87,7 +90,8 @@ def init_lora_params(
     return out
 
 
-def apply_lora(base_llama: Params, lora_params: Params, lora: LoraConfig) -> Params:
+def apply_lora(base_llama: Params, lora_params: Params, lora: LoraConfig,
+               dropout_key: Any = None) -> Params:
     """Frozen base + trainable LoRA -> effective LLaMA tree with *composite*
     weight leaves ``{"w": base, "a": A*scale, "b": B}`` that the matmul
     dispatch in ``ops/quant.py`` evaluates as ``x@w + (x@a)@b``.
@@ -97,20 +101,35 @@ def apply_lora(base_llama: Params, lora_params: Params, lora: LoraConfig) -> Par
     HBM; apply-form adds only the rank-r factors. Gradients w.r.t.
     ``lora_params`` flow through the two skinny matmuls; the base leaves
     enter as constants.
+
+    ``dropout_key`` (a per-step PRNG key) enables ``lora.dropout``: each
+    composite leaf gains per-layer mask keys ``"k"`` (L, 2) and the rate
+    ``"dr"`` (L,), stacked on the layer axis so the layer scan slices them
+    with A/B; the matmul dispatch then drops adapter-branch inputs (peft
+    semantics — the base ``x@w`` path is never dropped). With no key the
+    leaf carries no mask state and evaluation is deterministic.
     """
     scale = lora.scaling
+    use_dropout = lora.dropout > 0.0 and dropout_key is not None
     layers = base_llama["layers"]
     new_layers = {**layers}
-    for group in ("attn", "mlp"):
-        if group not in lora_params or not lora_params[group]:
+    for t_idx, (group, name) in enumerate(sorted(_TARGET_SHAPES)):
+        if group not in lora_params or name not in lora_params.get(group, {}):
             continue
-        new_group = {**layers[group]}
-        for name, ab in lora_params[group].items():
-            new_group[name] = {
-                "w": layers[group][name],
-                "a": ab["a"] * scale,
-                "b": ab["b"],
-            }
+        ab = lora_params[group][name]
+        new_group = dict(new_layers[group])
+        leaf = {
+            "w": layers[group][name],
+            "a": ab["a"] * scale,
+            "b": ab["b"],
+        }
+        if use_dropout:
+            num_layers = ab["a"].shape[0]
+            leaf["k"] = jax.random.split(
+                jax.random.fold_in(dropout_key, t_idx), num_layers
+            )
+            leaf["dr"] = jnp.full((num_layers,), lora.dropout, jnp.float32)
+        new_group[name] = leaf
         new_layers[group] = new_group
     return {**base_llama, "layers": new_layers}
 

@@ -83,23 +83,57 @@ class TrainState(NamedTuple):
     step: jnp.ndarray
 
 
-def stage1_combine(trainable: Params, frozen: Params) -> Params:
-    """Trainable = {"projector" [, "qformer"]}; CLIP + LM frozen."""
-    out = {"clip": frozen["clip"], "llama": frozen["llama"],
+def stage1_combine(trainable: Params, frozen: Params, step=None) -> Params:
+    """Trainable = {"projector" [, "qformer"] [, "embed_new"]}; CLIP + LM
+    frozen.
+
+    ``embed_new`` (present when ``mm_use_im_start_end`` added special
+    tokens) shadows the LAST rows of the frozen embedding table — the
+    masked-update form of the reference's ``initialize_vision_tokenizer``
+    (``model/EventChatModel.py:198-217``: new rows mean-init +
+    input-embeddings trainable; originals receive no gradient, and the
+    output head rows stay frozen as the reference sets
+    ``output_embeddings.requires_grad = False``).
+    """
+    llama = frozen["llama"]
+    if "embed_new" in trainable:
+        emb = llama["embed_tokens"]
+        n_new = trainable["embed_new"].shape[0]
+        llama = {**llama, "embed_tokens": jnp.concatenate(
+            [emb[:-n_new], trainable["embed_new"].astype(emb.dtype)]
+        )}
+    out = {"clip": frozen["clip"], "llama": llama,
            "projector": trainable["projector"]}
     if "qformer" in trainable:
         out["qformer"] = trainable["qformer"]
     return out
 
 
-def make_stage2_combine(lora_cfg: LoraConfig) -> Callable[[Params, Params], Params]:
-    """Trainable = {"projector", "lora"}; base LM enters as constants."""
+def make_stage2_combine(lora_cfg: LoraConfig,
+                        dropout_seed: int = 0,
+                        projector_source: str = "trainable") -> Callable[..., Params]:
+    """Trainable = {"projector", "lora"}; base LM enters as constants.
 
-    def combine(trainable: Params, frozen: Params) -> Params:
+    With ``lora_cfg.dropout > 0`` the returned combine takes a third
+    ``step`` argument: the train step passes its step counter, from which a
+    per-step dropout key derives (``fold_in`` — deterministic, resume-safe);
+    eval/serving pass ``None`` and get the deterministic adapted model.
+
+    ``projector_source="frozen"`` serves the ``freeze_mm_mlp_adapter``
+    recipe (projector moved to the frozen tree, SURVEY §2.2) — same combine
+    otherwise, so the dropout-key logic exists exactly once.
+    """
+
+    def combine(trainable: Params, frozen: Params, step=None) -> Params:
+        key = None
+        if lora_cfg.dropout > 0.0 and step is not None:
+            key = jax.random.fold_in(jax.random.PRNGKey(dropout_seed), step)
+        source = frozen if projector_source == "frozen" else trainable
         out = {
             "clip": frozen["clip"],
-            "projector": trainable["projector"],
-            "llama": apply_lora(frozen["llama"], trainable["lora"], lora_cfg),
+            "projector": source["projector"],
+            "llama": apply_lora(frozen["llama"], trainable["lora"], lora_cfg,
+                                dropout_key=key),
         }
         if "qformer" in trainable:
             out["qformer"] = trainable["qformer"]
@@ -124,7 +158,6 @@ def make_train_step(
     ``mesh`` enables sequence-parallel attention when its ``context`` axis
     is > 1 and ``cfg.llama.attn_impl`` is ``"ring"`` or ``"ulysses"``.
     """
-
     @functools.partial(
         jax.jit,
         static_argnames=(),
@@ -132,7 +165,10 @@ def make_train_step(
     )
     def step(state: TrainState, batch: Batch):
         def loss_fn(trainable):
-            params = combine(trainable, state.frozen)
+            # All combines share the (trainable, frozen, step) signature;
+            # the step counter drives per-step LoRA dropout keys. Eval
+            # paths call without it and stay deterministic.
+            params = combine(trainable, state.frozen, state.step)
             return _forward_loss(params, cfg, batch, mesh)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.trainable)
@@ -173,13 +209,22 @@ def init_train_state(
     )
 
 
-def split_stage1(params: Params) -> Tuple[Params, Params]:
+def split_stage1(params: Params,
+                 trainable_embed_rows: int = 0) -> Tuple[Params, Params]:
     """Full param tree -> (trainable, frozen) for stage 1.
 
     The Q-Former (when the config gates it in) trains alongside the
     projector — it sits on the same gradient path between the frozen CLIP
-    tower and the frozen LM."""
+    tower and the frozen LM.
+
+    ``trainable_embed_rows`` > 0 makes the LAST n embedding rows (the
+    special tokens ``mm_use_im_start_end`` just appended) a trainable leaf
+    — ``initialize_vision_tokenizer`` parity, see ``stage1_combine``."""
     trainable = {"projector": params["projector"]}
+    if trainable_embed_rows > 0:
+        trainable["embed_new"] = (
+            params["llama"]["embed_tokens"][-trainable_embed_rows:]
+        )
     if "qformer" in params:
         trainable["qformer"] = params["qformer"]
     return trainable, {"clip": params["clip"], "llama": params["llama"]}

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from eventgpt_tpu import checkpoint as ckpt
+from eventgpt_tpu import constants
 from eventgpt_tpu.config import EventChatConfig, MeshConfig
 from eventgpt_tpu.parallel import best_mesh_config, make_mesh, shard_params
 from eventgpt_tpu.parallel.dist import is_primary
@@ -111,6 +112,33 @@ class Trainer:
                 f"mesh_context={ctx} must divide the 64-token sequence bucket "
                 f"(use 2, 4, 8, ...)"
             )
+
+        # --- special-token registration (initialize_vision_tokenizer,
+        # model/EventChatModel.py:193-217): patch/start/end tokens grow the
+        # tokenizer, embeddings resize with mean-init of the new rows; when
+        # mm_use_im_start_end, the NEW rows additionally become a trainable
+        # stage-1 leaf (the reference unfreezes input embeddings and keeps
+        # the output head frozen).
+        self.num_new_im_tokens = 0
+        if model_args.mm_use_im_patch_token:
+            tokenizer.add_tokens([constants.DEFAULT_EVENT_PATCH_TOKEN],
+                                 special_tokens=True)
+        if model_args.mm_use_im_start_end:
+            self.num_new_im_tokens = tokenizer.add_tokens(
+                [constants.DEFAULT_EV_START_TOKEN, constants.DEFAULT_EV_END_TOKEN],
+                special_tokens=True,
+            )
+        if len(tokenizer) > cfg.llama.vocab_size:
+            from eventgpt_tpu.models.llama import resize_token_embeddings
+
+            import dataclasses as _dc
+
+            params = {**params,
+                      "llama": resize_token_embeddings(params["llama"],
+                                                       len(tokenizer))}
+            cfg = _dc.replace(
+                cfg, llama=_dc.replace(cfg.llama, vocab_size=len(tokenizer))
+            )
         self.cfg = cfg
 
         self.dataset = EventChatDataset(
@@ -141,7 +169,14 @@ class Trainer:
         proj_specs = projector_param_specs(
             cfg.projector.use_feature_adaptor, cfg.projector.mlp_depth
         )
-        frozen_specs = {"clip": clip_param_specs(), "llama": llama_param_specs()}
+        from eventgpt_tpu.parallel.sharding import vocab_safe_llama_specs
+
+        frozen_specs = {
+            "clip": clip_param_specs(),
+            "llama": vocab_safe_llama_specs(
+                llama_param_specs(), cfg.llama.vocab_size, mesh
+            ),
+        }
 
         self.lora_cfg: Optional[LoraConfig] = None
         if train_args.stage == 2 or train_args.lora_enable:
@@ -175,28 +210,30 @@ class Trainer:
                 trainable_specs = {
                     k: v for k, v in trainable_specs.items() if k != "projector"
                 }
-                lcfg = self.lora_cfg
-
-                def combine(tr, fz, _lcfg=lcfg):
-                    from eventgpt_tpu.train.lora import apply_lora
-
-                    out = {"clip": fz["clip"], "projector": fz["projector"],
-                           "llama": apply_lora(fz["llama"], tr["lora"], _lcfg)}
-                    if "qformer" in tr:
-                        out["qformer"] = tr["qformer"]
-                    return out
-
-                self.combine = combine
+                self.combine = steps_mod.make_stage2_combine(
+                    self.lora_cfg, dropout_seed=train_args.seed,
+                    projector_source="frozen",
+                )
             else:
-                self.combine = steps_mod.make_stage2_combine(self.lora_cfg)
+                self.combine = steps_mod.make_stage2_combine(
+                    self.lora_cfg, dropout_seed=train_args.seed
+                )
         else:
             if train_args.freeze_mm_mlp_adapter:
                 raise ValueError(
                     "freeze_mm_mlp_adapter with stage 1 would leave nothing "
                     "trainable (stage 1 trains only the projector)"
                 )
-            trainable, frozen = steps_mod.split_stage1(params)
+            trainable, frozen = steps_mod.split_stage1(
+                params, trainable_embed_rows=self.num_new_im_tokens
+            )
             trainable_specs = {"projector": proj_specs}
+            if "embed_new" in trainable:
+                from jax.sharding import PartitionSpec as P
+
+                # 2 rows cannot shard over the vocab ("model") axis the way
+                # the full table does; features follow the table's fsdp dim.
+                trainable_specs["embed_new"] = P(None, "fsdp")
             if "qformer" in trainable:
                 from eventgpt_tpu.parallel.sharding import qformer_param_specs
 
@@ -211,9 +248,9 @@ class Trainer:
         frozen = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype), frozen)
         base_combine = self.combine
 
-        def cast_combine(tr, fz, _base=base_combine, _dt=dtype):
+        def cast_combine(tr, fz, step=None, _base=base_combine, _dt=dtype):
             tr = jax.tree_util.tree_map(lambda x: x.astype(_dt), tr)
-            return _base(tr, fz)
+            return _base(tr, fz, step)
 
         self.combine = cast_combine
 
@@ -323,6 +360,12 @@ class Trainer:
             "opt_state": self.state.opt_state,
             "step": self.state.step,
         })
+        if is_primary():
+            # Durable step record: --resume_from auto orders checkpoints by
+            # this, never by mtime (which rsync/gcsfuse fabricate) — see
+            # checkpoint.find_latest_checkpoint.
+            with open(os.path.join(out, "STEP"), "w") as f:
+                f.write(str(int(jax.device_get(self.state.step))))
         self._last_ckpt = out
         if is_primary():
             if "projector" in self.state.trainable:
@@ -330,6 +373,20 @@ class Trainer:
                     os.path.join(self.targs.output_dir, f"projector_{tag}.npz"),
                     jax.device_get(self.state.trainable["projector"]),
                     prefix="model.visual_projector.",
+                )
+            if "embed_new" in self.state.trainable:
+                # Reference artifact shape: the trained special-token rows
+                # under 'model.embed_tokens.weight' — the
+                # initialize_vision_tokenizer load path accepts exactly the
+                # num_new_tokens rows (model/EventChatModel.py:225-227).
+                ckpt.save_component(
+                    os.path.join(self.targs.output_dir,
+                                 f"embed_tokens_{tag}.npz"),
+                    {"embed_tokens": {
+                        "weight": jax.device_get(
+                            self.state.trainable["embed_new"]
+                        )}},
+                    prefix="model.",
                 )
             if "lora" in self.state.trainable:
                 ckpt.save_component(

@@ -1,0 +1,112 @@
+"""LoRA dropout (VERDICT r2 missing #5): the recovered
+``TrainingArguments.lora_dropout`` knob (SURVEY §2.2), implemented in
+apply-form with peft semantics — dropout on the adapter-branch input only,
+drawn inside the jitted step, off at eval/serving."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.models import llama as llama_mod
+from eventgpt_tpu.ops import quant as quant_mod
+from eventgpt_tpu.train import steps as steps_mod
+from eventgpt_tpu.train.lora import LoraConfig, apply_lora, init_lora_params
+from eventgpt_tpu.train.optim import linear_warmup_cosine, make_optimizer
+
+
+def _cfg_and_lora(dropout):
+    cfg = EventChatConfig.tiny()
+    lcfg = LoraConfig(r=4, dropout=dropout)
+    params = llama_mod.init_llama_params(cfg.llama, jax.random.PRNGKey(0))
+    lora = init_lora_params(cfg.llama, lcfg, jax.random.PRNGKey(1))
+    # Fresh LoRA has B=0 -> zero delta regardless of dropout; make it real.
+    lora = jax.tree_util.tree_map(
+        lambda x: x + 0.05 * jnp.ones_like(x), lora
+    )
+    return cfg, lcfg, params, lora
+
+
+def test_dropout_range_validated():
+    with pytest.raises(ValueError, match="dropout"):
+        LoraConfig(dropout=1.0)
+    with pytest.raises(ValueError, match="dropout"):
+        LoraConfig(dropout=-0.1)
+    LoraConfig(dropout=0.5)  # no longer NotImplementedError
+
+
+def test_base_branch_never_dropped():
+    """peft semantics: y = x@W + dropout(x)@A@B — with A=B=0 the output
+    equals the plain base matmul bit-for-bit, dropout active or not."""
+    cfg, lcfg, params, _ = _cfg_and_lora(0.9)
+    zero_lora = jax.tree_util.tree_map(
+        jnp.zeros_like, init_lora_params(cfg.llama, lcfg, jax.random.PRNGKey(1))
+    )
+    eff = apply_lora(params, zero_lora, lcfg, dropout_key=jax.random.PRNGKey(7))
+    x = jax.random.normal(jax.random.PRNGKey(2), (3, cfg.llama.hidden_size))
+    leaf = jax.tree_util.tree_map(lambda v: v[0], eff["layers"]["attn"]["q"])
+    base = params["layers"]["attn"]["q"][0]
+    got = quant_mod.matmul(x, leaf)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(x @ base))
+
+
+def test_dropout_changes_adapter_output_and_is_deterministic_per_key():
+    cfg, lcfg, params, lora = _cfg_and_lora(0.5)
+    x = jax.random.normal(jax.random.PRNGKey(2), (3, cfg.llama.hidden_size))
+
+    def q_out(key):
+        eff = apply_lora(params, lora, lcfg, dropout_key=key)
+        leaf = jax.tree_util.tree_map(lambda v: v[0], eff["layers"]["attn"]["q"])
+        return np.asarray(quant_mod.matmul(x, leaf))
+
+    no_drop = apply_lora(params, lora, lcfg)
+    leaf0 = jax.tree_util.tree_map(lambda v: v[0], no_drop["layers"]["attn"]["q"])
+    clean = np.asarray(quant_mod.matmul(x, leaf0))
+
+    a = q_out(jax.random.PRNGKey(7))
+    b = q_out(jax.random.PRNGKey(7))
+    c = q_out(jax.random.PRNGKey(8))
+    np.testing.assert_array_equal(a, b)        # same key -> same mask
+    assert not np.allclose(a, c)               # different key -> different mask
+    assert not np.allclose(a, clean)           # dropout actually perturbs
+    # No key -> no mask state in the leaf at all.
+    assert "k" not in no_drop["layers"]["attn"]["q"]
+
+
+def test_train_step_with_dropout_runs_and_varies_per_step():
+    """Full stage-2 jitted step with dropout: finite loss, and the same
+    batch yields different losses at different step counters (fresh mask
+    per step via fold_in(step))."""
+    cfg = EventChatConfig.tiny()
+    lcfg = LoraConfig(r=4, dropout=0.3)
+    from eventgpt_tpu.models import eventchat
+    from eventgpt_tpu.train.data import synthetic_multimodal_batch
+
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    trainable, frozen = steps_mod.split_stage2(
+        params, cfg, lcfg, jax.random.PRNGKey(1)
+    )
+    # Nonzero B so the dropped branch contributes to the loss.
+    trainable["lora"] = jax.tree_util.tree_map(
+        lambda x: x + 0.02 * jnp.ones_like(x), trainable["lora"]
+    )
+    opt = make_optimizer(linear_warmup_cosine(0.0, 10, 0))  # lr=0: state fixed
+    state = steps_mod.init_train_state(trainable, frozen, opt)
+    step_fn = steps_mod.make_train_step(
+        cfg, opt, steps_mod.make_stage2_combine(lcfg), donate=False
+    )
+    batch = steps_mod.batch_to_device(synthetic_multimodal_batch(cfg, 2, 32, 8))
+
+    state1, m1 = step_fn(state, batch)
+    _, m2 = step_fn(state1, batch)
+    l1, l2 = float(m1["loss"]), float(m2["loss"])
+    assert np.isfinite(l1) and np.isfinite(l2)
+    # lr=0 keeps weights identical; only the step counter (mask) changed.
+    assert l1 != l2
+
+    # Eval on the same state is deterministic (no step -> no dropout).
+    eval_fn = steps_mod.make_eval_step(cfg, steps_mod.make_stage2_combine(lcfg))
+    e1 = float(eval_fn(state, batch)["loss"])
+    e2 = float(eval_fn(state, batch)["loss"])
+    assert e1 == e2

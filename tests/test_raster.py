@@ -118,3 +118,44 @@ def test_out_of_frame_events_dropped_not_raised():
     assert (frame[0, 0] == [255, 0, 0]).all()     # polarity 1 -> red
     assert (frame[5, 5] == [0, 0, 255]).all()     # polarity 0 -> blue
     assert (frame[9, 9] == [255, 255, 255]).all()  # untouched background
+
+
+def test_load_event_npy_structured_no_pickle(tmp_path):
+    """Native structured-array streams load with pickle fully disabled."""
+    import numpy as np
+
+    from eventgpt_tpu.ops.raster import load_event_npy
+
+    arr = np.zeros(7, dtype=[("t", "<u4"), ("x", "<u2"), ("y", "<u2"), ("p", "u1")])
+    arr["x"] = np.arange(7)
+    p = tmp_path / "ev.npy"
+    np.save(p, arr)
+    d = load_event_npy(str(p))
+    assert sorted(d) == ["p", "t", "x", "y"]
+    assert (d["x"] == np.arange(7)).all()
+
+
+def test_load_event_npy_blocks_malicious_pickle(tmp_path):
+    """Legacy pickled dicts go through a restricted unpickler: arbitrary
+    callables (the allow_pickle=True RCE surface, common/common.py:111) are
+    rejected before execution."""
+    import pickle
+
+    import numpy as np
+    import pytest
+
+    from eventgpt_tpu.ops.raster import load_event_npy
+
+    marker = tmp_path / "pwned"
+
+    class Evil:
+        def __reduce__(self):
+            import os
+
+            return (os.system, (f"touch {marker}",))
+
+    p = tmp_path / "evil.npy"
+    np.save(p, np.array({"x": Evil()}, dtype=object))
+    with pytest.raises(pickle.UnpicklingError, match="blocked"):
+        load_event_npy(str(p))
+    assert not marker.exists()

@@ -197,9 +197,11 @@ def test_invalid_divergence_policy_rejected(toy_data, tmp_path):
 
 
 def test_find_latest_orders_by_step_not_mtime(tmp_path):
-    """Step number is the primary key: synthetic mtimes (gcsfuse, rsync)
-    must not reorder step checkpoints; ckpt_last/bare ckpt_preempt only win
-    via mtime against the best step save."""
+    """The recorded step (STEP file, falling back to the name) is the
+    primary key: synthetic mtimes (gcsfuse, rsync, copied dirs) must not
+    reorder checkpoints. A stepless legacy ckpt_last never beats a
+    step-recorded save (ADVICE r2), and mtime only arbitrates between
+    checkpoints with no recorded step at all."""
     import os as _os
 
     from eventgpt_tpu.checkpoint import find_latest_checkpoint
@@ -212,11 +214,31 @@ def test_find_latest_orders_by_step_not_mtime(tmp_path):
     # Preempt at the same step wins the tie (written after the periodic save).
     (tmp_path / "ckpt_preempt_step9").mkdir()
     assert find_latest_checkpoint(str(tmp_path)).endswith("ckpt_preempt_step9")
-    # ckpt_last with a newer mtime than the best step save wins.
+    # A STALE copied ckpt_last (no STEP record, arbitrary newer mtime) must
+    # NOT discard the step-9 training state.
     last = tmp_path / "ckpt_last"
     last.mkdir()
     _os.utime(last, (3e9, 3e9))
+    assert find_latest_checkpoint(str(tmp_path)).endswith("ckpt_preempt_step9")
+    # With its recorded step (what trainer.save writes), ckpt_last competes
+    # by step and wins when genuinely newest...
+    (last / "STEP").write_text("12")
     assert find_latest_checkpoint(str(tmp_path)).endswith("ckpt_last")
+    # ...and loses when its recorded step is older, mtime notwithstanding.
+    (last / "STEP").write_text("3")
+    assert find_latest_checkpoint(str(tmp_path)).endswith("ckpt_preempt_step9")
+    # A STEP file inside a step-named dir overrides the name.
+    ((tmp_path / "ckpt_step1") / "STEP").write_text("40")
+    assert find_latest_checkpoint(str(tmp_path)).endswith("ckpt_step1")
+    # Only stepless checkpoints fall back to mtime, among themselves.
+    import shutil
+
+    for d in tmp_path.iterdir():
+        shutil.rmtree(d)
+    (tmp_path / "ckpt_last").mkdir()
+    (tmp_path / "ckpt_preempt").mkdir()
+    _os.utime(tmp_path / "ckpt_preempt", (4e9, 4e9))
+    assert find_latest_checkpoint(str(tmp_path)).endswith("ckpt_preempt")
 
 
 def test_second_signal_escalates():

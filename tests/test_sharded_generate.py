@@ -200,3 +200,25 @@ def test_eval_cli_mesh_kv_fuse(tmp_path):
         "--mesh_data", "2", "--mesh_fsdp", "2", "--mesh_model", "2",
     ])
     assert out_nofuse == ref
+
+
+def test_sharded_generate_odd_vocab_replicates_vocab_dim():
+    """Special-token registration grows the vocab to sizes that don't
+    divide the model axis (32000 -> 32003); the vocab dim must fall back
+    to replication instead of crashing device_put."""
+    cfg = EventChatConfig.tiny(vocab_size=257)  # odd: 257 % 2 != 0
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    pixels = rng.normal(
+        size=(2, cfg.num_event_frames, 3, cfg.vision.image_size,
+              cfg.vision.image_size)
+    ).astype(np.float32)
+    ids = [[1, 5, 9, -200, 17, 23], [1, 6, 9, -200, 18, 24]]
+    ref = eventchat.generate(params, cfg, ids, pixels, max_new_tokens=6,
+                             temperature=0.0)
+    mesh = _mesh()
+    out = eventchat.generate(
+        shard_params_for_serving(params, cfg, mesh), cfg, ids, pixels,
+        max_new_tokens=6, temperature=0.0, mesh=mesh,
+    )
+    assert out == ref

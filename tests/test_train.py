@@ -140,9 +140,12 @@ def test_lora_zero_init_is_identity(tiny):
         )
 
 
-def test_lora_dropout_rejected():
-    with pytest.raises(NotImplementedError):
-        LoraConfig(dropout=0.1)
+def test_lora_dropout_range_validated():
+    # Dropout is implemented (tests/test_lora_dropout.py); only the range
+    # is policed here.
+    with pytest.raises(ValueError):
+        LoraConfig(dropout=1.5)
+    LoraConfig(dropout=0.1)
 
 
 def test_apply_lora_matches_merge_lora(tiny):

@@ -1,0 +1,137 @@
+"""initialize_vision_tokenizer parity (VERDICT r2 missing #4): with
+``mm_use_im_start_end`` the newly added special-token embedding rows are
+mean-initialized AND trainable in stage 1 — originals frozen, output head
+frozen (``model/EventChatModel.py:193-217``)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.models import eventchat
+from eventgpt_tpu.train import steps as steps_mod
+from eventgpt_tpu.train.data import synthetic_multimodal_batch
+from eventgpt_tpu.train.optim import linear_warmup_cosine, make_optimizer
+
+SAMPLE_DIR = "/root/reference/samples"
+
+
+def test_stage1_embed_new_rows_trainable_and_originals_frozen():
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    from eventgpt_tpu.models.llama import resize_token_embeddings
+
+    old_vocab = cfg.llama.vocab_size
+    n_new = 2
+    params["llama"] = resize_token_embeddings(params["llama"], old_vocab + n_new)
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        cfg, llama=dataclasses.replace(cfg.llama, vocab_size=old_vocab + n_new)
+    )
+
+    trainable, frozen = steps_mod.split_stage1(params, trainable_embed_rows=n_new)
+    assert trainable["embed_new"].shape == (n_new, cfg.llama.hidden_size)
+    # Mean-init parity: new rows start at the mean of the original rows.
+    np.testing.assert_allclose(
+        np.asarray(trainable["embed_new"]),
+        np.broadcast_to(
+            np.asarray(params["llama"]["embed_tokens"][:old_vocab]).mean(0),
+            (n_new, cfg.llama.hidden_size),
+        ),
+        rtol=1e-4, atol=1e-7,
+    )
+
+    # Combine: effective table == frozen table except the shadowed rows.
+    eff = steps_mod.stage1_combine(trainable, frozen)
+    np.testing.assert_array_equal(
+        np.asarray(eff["llama"]["embed_tokens"][:old_vocab]),
+        np.asarray(frozen["llama"]["embed_tokens"][:old_vocab]),
+    )
+
+    # One optimizer step on a batch containing a new-token id: only the new
+    # rows of the effective table (and nothing in the frozen tree) change.
+    opt = make_optimizer(linear_warmup_cosine(1e-2, 10, 0))
+    state = steps_mod.init_train_state(trainable, frozen, opt)
+    step_fn = steps_mod.make_train_step(
+        cfg, opt, steps_mod.stage1_combine, donate=False
+    )
+    host = synthetic_multimodal_batch(cfg, 2, 32, 8)
+    # Splice a new-token id into the text positions so its row gets signal.
+    ids = np.asarray(host["token_ids"]).copy()
+    ids[:, 1] = old_vocab  # first new token
+    host["token_ids"] = ids
+    batch = steps_mod.batch_to_device(host)
+
+    new_state, metrics = step_fn(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    g_new = np.asarray(new_state.trainable["embed_new"]) - np.asarray(
+        trainable["embed_new"]
+    )
+    assert np.abs(g_new[0]).max() > 0  # the used new row moved
+    # Frozen tree untouched (no gradient path by construction).
+    np.testing.assert_array_equal(
+        np.asarray(new_state.frozen["llama"]["embed_tokens"]),
+        np.asarray(frozen["llama"]["embed_tokens"]),
+    )
+    # lm_head (output embeddings) stays frozen — reference sets
+    # output_embeddings.requires_grad = False.
+    np.testing.assert_array_equal(
+        np.asarray(new_state.frozen["llama"]["lm_head"]),
+        np.asarray(frozen["llama"]["lm_head"]),
+    )
+
+
+def test_trainer_registers_tokens_and_saves_embed_artifact(tmp_path):
+    if not os.path.exists(os.path.join(SAMPLE_DIR, "sample1.npy")):
+        pytest.skip("reference sample not available")
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.train.args import (
+        DataArguments, ModelArguments, TrainingArguments,
+    )
+    from eventgpt_tpu.train.trainer import Trainer
+
+    entries = [
+        {"id": i, "event": "sample1.npy",
+         "conversations": [
+             {"from": "human", "value": "<event>\nDescribe the scene."},
+             {"from": "gpt", "value": f"Answer number {i}."},
+         ]}
+        for i in range(4)
+    ]
+    data = tmp_path / "qa.json"
+    data.write_text(json.dumps(entries))
+
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    tok = load_tokenizer("byte")
+    vocab_before = len(tok)
+    targs = TrainingArguments(
+        output_dir=str(tmp_path / "out"), stage=1, max_steps=1,
+        per_device_train_batch_size=2, logging_steps=1, save_steps=-1,
+        bf16=False, learning_rate=1e-2, mesh_data=1, mesh_fsdp=2,
+    )
+    tr = Trainer(
+        cfg, params, tok,
+        ModelArguments(mm_use_im_start_end=True),
+        DataArguments(data_path=str(data), event_folder=SAMPLE_DIR),
+        targs,
+    )
+    assert tr.num_new_im_tokens == 2
+    assert len(tok) == vocab_before + 3  # patch + start + end
+    assert tr.cfg.llama.vocab_size == len(tok)
+    assert "embed_new" in tr.state.trainable
+
+    metrics = tr.train()
+    assert np.isfinite(metrics["loss"])
+    tr.save("last")
+    art = np.load(str(tmp_path / "out" / "embed_tokens_last.npz"))
+    # Reference load-path shape: exactly the num_new_tokens rows under the
+    # 'model.embed_tokens.weight' key (model/EventChatModel.py:225-227).
+    assert art["model.embed_tokens.weight"].shape == (
+        2, cfg.llama.hidden_size,
+    )
