@@ -259,6 +259,90 @@ def run_decode(args):
     return _emit(record, "decode", tok_s)
 
 
+def run_spec(args):
+    """Speculative-decoding leg: greedy decode through the n-gram-draft +
+    K-token-verify loop (``models/eventchat.py:_spec_loop_jit``).
+
+    Zero-filled bench weights produce a constant greedy chain, which the
+    bigram lookup drafts perfectly — so the measured tok/s is the acceptance
+    CEILING (every iteration commits the full window). The zero-acceptance
+    FLOOR needs no separate run: every loop iteration costs the same wall
+    time regardless of how many drafts verify (all shapes are static), so
+    floor = iterations / dt — one committed token per iteration. Real
+    checkpoints land between the two according to how repetitive the
+    generated text is; tokens-per-iteration is recorded so the acceptance is
+    read, never inferred. (A "random weights" floor was tried and rejected:
+    random logits still collapse to a repetitive argmax chain — the dominant
+    lm_head column wins for most hidden states — and the lookup drafts it.)
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from eventgpt_tpu.data.tokenizer import split_at_event
+    from eventgpt_tpu.models import eventchat, llama as llama_mod
+    from eventgpt_tpu.models.eventchat import (
+        _pad_batch, _prefill_jit, _spec_loop_jit, _spliced_text_ids,
+        splice_embeddings,
+    )
+
+    preset, cfg, platform = _resolve_preset(args)
+    dtype = jnp.bfloat16
+    quant = args.quant if preset in ("7b", "13b") else "bf16"
+    params = _build_params(cfg, dtype, quant)
+
+    pixels = jnp.asarray(_event_pixels(cfg, 1), dtype)
+    ids = [1] + [7] * 34 + [-200] + [9] * 16
+    window = args.spec_window
+    ev = eventchat.encode_events_batch(params, cfg, pixels)
+    embeds = [splice_embeddings(params, cfg, split_at_event(ids), ev[0])]
+    padded, mask, lens = _pad_batch(embeds)
+    prompt_len = int(lens[0])
+    cache_len = ((prompt_len + args.decode_tokens + 2 * window + 64) // 64) * 64
+
+    ids_host = np.full((1, cache_len), -1, np.int32)
+    row = _spliced_text_ids(split_at_event(ids), cfg.num_event_tokens,
+                            cfg.llama.max_seq_len)
+    ids_host[0, : len(row)] = row
+    plens = jnp.asarray(lens.astype(np.int32))
+
+    def prefill_once():
+        cache = llama_mod.init_kv_cache(cfg.llama, 1, cache_len, dtype)
+        return _prefill_jit(params, cfg, padded, mask, cache, True)
+
+    loop = lambda lg, cch: _spec_loop_jit(
+        params, cfg, lg, cch, jnp.asarray(ids_host), plens,
+        args.decode_tokens, window, -1,
+    )
+    last, cache = prefill_once()
+    out, n_gen, n_iters = loop(last, cache)  # compile
+    _sync(out)
+    last, cache = prefill_once()
+    _sync(last)
+    t0 = time.perf_counter()
+    out, n_gen, n_iters = loop(last, cache)
+    _sync(out)
+    dt = time.perf_counter() - t0
+    committed = min(int(n_gen[0]), args.decode_tokens)
+    iters = int(n_iters)
+
+    record = {
+        "metric": f"spec_decode_{preset}",
+        "value": round(committed / dt, 2),  # ceiling: zeros weights draft fully
+        "unit": "tok/s",
+        "window": window,
+        "decode_tokens": committed,
+        "iterations": iters,
+        "tokens_per_iteration": round(committed / max(iters, 1), 2),
+        # Zero-acceptance bound from the SAME run: one committed token per
+        # iteration at the measured (shape-static) iteration cost.
+        "floor_tok_s": round(iters / dt, 2),
+        "quant": quant,
+        "platform": platform,
+    }
+    print(json.dumps(record))
+    return record
+
+
 def run_warm_probe(args):
     """Cold-start probe: encode + prefill first-call latency in THIS process.
 
@@ -421,6 +505,21 @@ def run_all(args):
         except Exception as e:
             sys.stderr.write(f"13b leg failed: {e}\n")
 
+    # Speculative decode bracket from ONE leg: ceiling (zeros weights give a
+    # fully-draftable chain) and the zero-acceptance floor (iterations/dt —
+    # exact, since iteration cost is shape-static). Real-checkpoint
+    # throughput lands between them by text repetitiveness.
+    try:
+        sc = _leg(["--mode", "spec", "--preset", args.preset,
+                   "--decode_tokens", str(args.decode_tokens),
+                   "--quant", args.quant,
+                   "--spec_window", str(args.spec_window)])
+        record["spec_ceiling_tok_s"] = sc["value"]
+        record["spec_floor_tok_s"] = sc["floor_tok_s"]
+        record["spec_tokens_per_iteration"] = sc["tokens_per_iteration"]
+    except Exception as e:
+        sys.stderr.write(f"spec leg failed: {e}\n")
+
     try:
         tr = _leg(["--mode", "train", "--preset", args.preset,
                    "--quant", args.quant, "--steps", str(args.steps),
@@ -436,7 +535,9 @@ def run_all(args):
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--mode", default="all",
-                   choices=["all", "decode", "train", "warm_probe"])
+                   choices=["all", "decode", "train", "warm_probe", "spec"])
+    p.add_argument("--spec_window", type=int, default=8,
+                   help="speculative verify window (mode=spec)")
     p.add_argument("--preset", default="auto", choices=["auto", "7b", "13b", "tiny"])
     # Reference run shape: inference.py:19 max_new_tokens=512.
     p.add_argument("--decode_tokens", type=int, default=512)
@@ -467,6 +568,8 @@ def main() -> None:
         run_decode(args)
     elif args.mode == "warm_probe":
         run_warm_probe(args)
+    elif args.mode == "spec":
+        run_spec(args)
     else:
         run_train(args)
 

@@ -65,6 +65,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--use_event_qformer", action="store_true")
     p.add_argument("--pretrain_query_embedder", type=str, default=None)
     p.add_argument("--pretrain_attention_layers", type=str, default=None)
+    p.add_argument("--speculative", type=int, default=0,
+                   help="speculative decode window (exact greedy equivalence; "
+                        "requires temperature 0, num_beams 1, single chip)")
     p.add_argument("--timing", action="store_true")
     return p
 
@@ -127,6 +130,7 @@ def main(argv=None):
         num_beams=args.num_beams,
         kv_quant=args.kv_cache == "int8",
         mesh=mesh,
+        speculative=args.speculative,
     )
     t_gen = time.perf_counter() - t0
 

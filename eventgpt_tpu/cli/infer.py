@@ -81,6 +81,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ZeRO/FSDP weight-sharding axis of the serving mesh")
     p.add_argument("--mesh_model", type=int, default=1,
                    help="tensor-parallel axis of the serving mesh")
+    p.add_argument("--speculative", type=int, default=0,
+                   help="speculative decode window (n-gram draft + K-token "
+                        "verify; exact greedy equivalence — requires "
+                        "temperature 0, num_beams 1, single chip; 0 = off)")
     p.add_argument("--timing", action="store_true", help="print stage timings to stderr")
     # Q-Former serving (the use_event_qformer surface): enable the gate and
     # load the trained component artifacts written by the trainer
@@ -289,6 +293,7 @@ def main(argv=None) -> str:
         num_beams=args.num_beams,
         kv_quant=args.kv_cache == "int8",
         mesh=mesh,
+        speculative=args.speculative,
     )[0]
     t_gen = time.perf_counter() - t0
 

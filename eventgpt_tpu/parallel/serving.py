@@ -192,6 +192,56 @@ def shard_kv_cache(cache: Any, cfg, mesh: Mesh) -> Any:
     }
 
 
+def serving_flash_shard_map(mesh: Mesh, batch: int, num_heads: Optional[int] = None):
+    """Pallas flash prefill under a serving mesh.
+
+    The flash kernel is an opaque custom call to the SPMD partitioner, so a
+    bare call inside the pjit'd prefill would force an all-gather of every
+    operand. Wrapped in shard_map it runs fully locally instead: batch over
+    the serving batch axes, heads over ``model`` — the same layout the
+    surrounding qkv/o matmuls already produce, so no resharding happens at
+    the boundary and sharded prefill keeps flash's O(S) memory instead of
+    falling back to dense (B, H, T, T) scores. Sequence stays unsharded
+    (serving meshes have context=1, ``_require_serving_mesh``); causality is
+    therefore purely local. Caller guarantees num_heads %% model == 0.
+
+    Returns ``f(q, k, v, valid) -> out`` with q/k/v (B, S, H, hd) post-GQA
+    repeat and valid (B, S) bool.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from eventgpt_tpu.ops.flash_attention import flash_attention
+
+    model_n = mesh.shape.get("model", 1)
+    if num_heads is not None and num_heads % model_n:
+        # Validate at the mechanism layer (every caller), not just at
+        # generate()'s downgrade site — otherwise the failure is an opaque
+        # shard_map divisibility trace.
+        raise ValueError(
+            f"flash under a serving mesh shards heads over model: "
+            f"num_heads={num_heads} must divide by model={model_n} "
+            f"(use dense attention otherwise)"
+        )
+    baxes = serving_batch_axes(mesh, batch)
+    bspec = baxes if baxes else None
+    head_ax = "model" if mesh.shape.get("model", 1) > 1 else None
+    qkv_spec = P(bspec, None, head_ax, None)
+    valid_spec = P(bspec, None)
+
+    def local(q, k, v, valid):
+        return flash_attention(q, k, v, valid=valid, causal=True)
+
+    # check_vma=False: the pallas_call's out ShapeDtypeStruct carries no
+    # varying-mesh-axes annotation, and the kernel is purely local anyway
+    # (no collectives inside).
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, valid_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+
+
 def build_serving_mesh(
     data: int = 1, fsdp: int = 1, model: int = 1,
     devices: Optional[list] = None,

@@ -40,6 +40,7 @@ from eventgpt_tpu.constants import (
     DEFAULT_EVENT_TOKEN,
     EVENT_TOKEN_INDEX,
     IGNORE_INDEX,
+    SEQ_BUCKET,
 )
 from eventgpt_tpu.data.conversation import conv_templates
 from eventgpt_tpu.data.tokenizer import tokenize_with_event
@@ -244,7 +245,7 @@ def collate_fixed_layout(
     samples: Sequence[Sample],
     cfg: EventChatConfig,
     max_len: Optional[int] = None,
-    bucket: int = 64,
+    bucket: int = SEQ_BUCKET,
 ) -> Dict[str, np.ndarray]:
     """Fixed-layout multimodal batch (the jit-friendly splice redesign).
 

@@ -103,14 +103,14 @@ class Trainer:
                     f"num_heads/model = {local_heads} must divide by "
                     f"mesh_context={ctx} (use attn_impl='ring' otherwise)"
                 )
-        if ctx > 1 and 64 % ctx:
-            # Collated batches pad T to a multiple of the 64-token bucket
+        if ctx > 1 and constants.SEQ_BUCKET % ctx:
+            # Collated batches pad T to a multiple of the SEQ_BUCKET grain
             # (train/data.py:collate_fixed_layout), so a context size that
-            # divides 64 always divides T; anything else would die with an
+            # divides it always divides T; anything else would die with an
             # opaque shard_map divisibility error on the first step.
             raise ValueError(
-                f"mesh_context={ctx} must divide the 64-token sequence bucket "
-                f"(use 2, 4, 8, ...)"
+                f"mesh_context={ctx} must divide the {constants.SEQ_BUCKET}-token "
+                f"sequence bucket (use 2, 4, 8, ...)"
             )
 
         # --- special-token registration (initialize_vision_tokenizer,

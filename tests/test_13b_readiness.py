@@ -22,6 +22,8 @@ from eventgpt_tpu.train.data import synthetic_multimodal_batch
 from eventgpt_tpu.train.lora import LoraConfig, lora_param_specs
 from eventgpt_tpu.train.optim import linear_warmup_cosine, make_optimizer
 
+pytestmark = pytest.mark.slow  # heavyweight e2e/mesh tier (-m 'not slow' to skip)
+
 
 def _abstract(tree, shardings=None):
     if shardings is None:

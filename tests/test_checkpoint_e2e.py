@@ -21,6 +21,8 @@ from eventgpt_tpu.data.tokenizer import ByteTokenizer, tokenize_with_event
 from eventgpt_tpu.models import convert, eventchat
 from eventgpt_tpu.models.llama import resize_token_embeddings
 
+pytestmark = pytest.mark.slow  # heavyweight e2e/mesh tier (-m 'not slow' to skip)
+
 SAMPLE = "/root/reference/samples/sample1.npy"
 
 

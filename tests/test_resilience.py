@@ -20,6 +20,8 @@ from eventgpt_tpu.train.args import DataArguments, ModelArguments, TrainingArgum
 from eventgpt_tpu.train.resilience import GracefulShutdown, Heartbeat
 from eventgpt_tpu.train.trainer import Trainer, TrainingDivergedError
 
+pytestmark = pytest.mark.slow  # heavyweight e2e/mesh tier (-m 'not slow' to skip)
+
 SAMPLE_DIR = "/root/reference/samples"
 
 

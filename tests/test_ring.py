@@ -9,6 +9,8 @@ from eventgpt_tpu.config import MeshConfig
 from eventgpt_tpu.parallel import make_mesh
 from eventgpt_tpu.parallel.ring import dense_reference_attention, ring_self_attention
 
+pytestmark = pytest.mark.slow  # heavyweight e2e/mesh tier (-m 'not slow' to skip)
+
 
 @pytest.mark.parametrize("mesh_cfg,shape", [
     (MeshConfig(data=2, fsdp=1, context=4, model=1), (2, 32, 4, 8)),

@@ -25,6 +25,8 @@ from eventgpt_tpu.parallel.serving import (
     shard_params_for_serving,
 )
 
+pytestmark = pytest.mark.slow  # heavyweight e2e/mesh tier (-m 'not slow' to skip)
+
 
 def _setup(batch: int, seed: int = 0):
     cfg = EventChatConfig.tiny()
@@ -220,5 +222,24 @@ def test_sharded_generate_odd_vocab_replicates_vocab_dim():
     out = eventchat.generate(
         shard_params_for_serving(params, cfg, mesh), cfg, ids, pixels,
         max_new_tokens=6, temperature=0.0, mesh=mesh,
+    )
+    assert out == ref
+
+
+def test_sharded_generate_flash_prefill_matches_dense():
+    """attn_impl='flash' under a serving mesh runs the Pallas kernel
+    per-shard (serving_flash_shard_map) — same tokens as the dense-mask
+    sharded path and as single-chip flash."""
+    cfg, params, ids, pixels = _setup(batch=2)
+    cfg_flash = dataclasses.replace(
+        cfg, llama=dataclasses.replace(cfg.llama, attn_impl="flash")
+    )
+    ref = eventchat.generate(
+        params, cfg_flash, ids, pixels, max_new_tokens=6, temperature=0.0
+    )
+    mesh = _mesh()
+    out = eventchat.generate(
+        shard_params_for_serving(params, cfg_flash, mesh), cfg_flash, ids,
+        pixels, max_new_tokens=6, temperature=0.0, mesh=mesh,
     )
     assert out == ref

@@ -1,0 +1,183 @@
+"""Speculative decoding: exact greedy equivalence + acceptance behavior.
+
+The contract (``models/eventchat.py:_spec_loop_jit``): for temperature 0,
+speculative generation returns EXACTLY the plain greedy token chain — drafts
+are committed only when they equal the verifier's argmax, and the first
+mismatch is replaced by that argmax. The reference has no counterpart
+(HF generate decodes one token per forward, ``inference.py:52-63``); this is
+TPU-native headroom on a weight-bandwidth-bound decode.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.models import eventchat, llama as llama_mod
+
+pytestmark = pytest.mark.slow  # heavyweight e2e/mesh tier (-m 'not slow' to skip)
+
+EOS = 2
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(3))
+    return cfg, params
+
+
+def _pv(cfg, b=1):
+    return jnp.zeros(
+        (b, cfg.num_event_frames, 3, cfg.vision.image_size, cfg.vision.image_size),
+        jnp.float32,
+    )
+
+
+def test_kstep_matches_sequential_decode_steps(tiny):
+    """decode_kstep over a K-window == K decode_steps fed one at a time."""
+    cfg, params = tiny
+    b, t, k = 2, 5, 4
+    key = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(key, (b, t), 0, cfg.llama.vocab_size)
+    embeds = llama_mod.embed_tokens(params["llama"], prompt)
+    mask = jnp.ones((b, t), bool)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (b, k), 0, cfg.llama.vocab_size)
+
+    cache = llama_mod.init_kv_cache(cfg.llama, b, t + k + 2, jnp.float32)
+    _, cache_a = llama_mod.prefill(params["llama"], cfg.llama, embeds, mask, cache)
+    seq_logits = []
+    for i in range(k):
+        e = llama_mod.embed_tokens(params["llama"], toks[:, i][:, None])
+        lg, cache_a = llama_mod.decode_step(params["llama"], cfg.llama, e, cache_a)
+        seq_logits.append(lg)
+    seq_logits = jnp.stack(seq_logits, axis=1)  # (B, K, V)
+
+    cache = llama_mod.init_kv_cache(cfg.llama, b, t + k + 2, jnp.float32)
+    _, cache_b = llama_mod.prefill(params["llama"], cfg.llama, embeds, mask, cache)
+    win_embeds = llama_mod.embed_tokens(params["llama"], toks)
+    win_logits, cache_b = llama_mod.decode_kstep(
+        params["llama"], cfg.llama, win_embeds, cache_b
+    )
+    np.testing.assert_allclose(
+        np.asarray(win_logits), np.asarray(seq_logits), rtol=1e-5, atol=1e-5
+    )
+    assert int(cache_b["length"][0]) == t + k
+    np.testing.assert_allclose(
+        np.asarray(cache_b["k"][:, :, : t + k]),
+        np.asarray(cache_a["k"][:, :, : t + k]),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("window", [1, 2, 4, 8])
+def test_spec_equals_plain_greedy(tiny, window):
+    cfg, params = tiny
+    ids = [1, 5, -200, 9, 9, 31]
+    plain = eventchat.generate(
+        params, cfg, [ids], _pv(cfg), max_new_tokens=12,
+        temperature=0.0, eos_token_id=None,
+    )[0]
+    spec = eventchat.generate(
+        params, cfg, [ids], _pv(cfg), max_new_tokens=12,
+        temperature=0.0, eos_token_id=None, speculative=window,
+    )[0]
+    assert spec == plain
+    assert len(plain) == 12
+
+
+def test_spec_equals_plain_greedy_with_eos(tiny):
+    """Pick an EOS id that actually occurs mid-chain so early-stop paths run."""
+    cfg, params = tiny
+    ids = [1, 5, -200, 9, 9, 31]
+    plain_full = eventchat.generate(
+        params, cfg, [ids], _pv(cfg), max_new_tokens=12,
+        temperature=0.0, eos_token_id=None,
+    )[0]
+    eos = plain_full[5]  # force a stop ~5 tokens in
+    plain = eventchat.generate(
+        params, cfg, [ids], _pv(cfg), max_new_tokens=12,
+        temperature=0.0, eos_token_id=eos,
+    )[0]
+    for window in (2, 4):
+        spec = eventchat.generate(
+            params, cfg, [ids], _pv(cfg), max_new_tokens=12,
+            temperature=0.0, eos_token_id=eos, speculative=window,
+        )[0]
+        assert spec == plain
+    assert len(plain) < 12
+
+
+def test_spec_batched_equals_plain(tiny):
+    cfg, params = tiny
+    batch = [[1, 5, -200, 9], [1, -200, 7, 7, 8, 14]]
+    plain = eventchat.generate(
+        params, cfg, batch, _pv(cfg, 2), max_new_tokens=10,
+        temperature=0.0, eos_token_id=None,
+    )
+    spec = eventchat.generate(
+        params, cfg, batch, _pv(cfg, 2), max_new_tokens=10,
+        temperature=0.0, eos_token_id=None, speculative=4,
+    )
+    assert spec == plain
+
+
+def test_spec_kv_quant_equals_plain_kv_quant(tiny):
+    cfg, params = tiny
+    ids = [1, 5, -200, 9, 9]
+    plain = eventchat.generate(
+        params, cfg, [ids], _pv(cfg), max_new_tokens=8,
+        temperature=0.0, eos_token_id=None, kv_quant=True,
+    )[0]
+    spec = eventchat.generate(
+        params, cfg, [ids], _pv(cfg), max_new_tokens=8,
+        temperature=0.0, eos_token_id=None, kv_quant=True, speculative=4,
+    )[0]
+    assert spec == plain
+
+
+def test_spec_acceptance_on_repetitive_chain(tiny):
+    """Zero params -> constant greedy chain -> the bigram lookup drafts it
+    perfectly and iterations collapse to ~max_new/window."""
+    cfg, _ = tiny
+    params = jax.tree_util.tree_map(
+        jnp.zeros_like, eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    )
+    stats = {}
+    out = eventchat.generate(
+        params, cfg, [[1, 5, -200, 9]], _pv(cfg), max_new_tokens=16,
+        temperature=0.0, eos_token_id=None, speculative=4, spec_stats=stats,
+    )[0]
+    assert out == [0] * 16
+    # 16 tokens at window 4: 1 prefill token + ceil(15/4) = 4 iterations.
+    assert stats["iterations"] <= 6
+    assert stats["tokens"] == 16
+
+
+def test_spec_worst_case_still_exact(tiny):
+    """Random-params chain (near-zero acceptance): every iteration commits
+    at least the correction token and the output is still the greedy chain."""
+    cfg, params = tiny
+    ids = [3, -200, 11]
+    stats = {}
+    plain = eventchat.generate(
+        params, cfg, [ids], _pv(cfg), max_new_tokens=9,
+        temperature=0.0, eos_token_id=None,
+    )[0]
+    spec = eventchat.generate(
+        params, cfg, [ids], _pv(cfg), max_new_tokens=9,
+        temperature=0.0, eos_token_id=None, speculative=3, spec_stats=stats,
+    )[0]
+    assert spec == plain
+    assert stats["iterations"] <= 9  # never worse than one per token
+
+
+def test_spec_validation(tiny):
+    cfg, params = tiny
+    with pytest.raises(ValueError, match="greedy-only"):
+        eventchat.generate(params, cfg, [[1, -200]], _pv(cfg), max_new_tokens=2,
+                           num_beams=2, speculative=2)
+    with pytest.raises(ValueError, match="temperature 0"):
+        eventchat.generate(params, cfg, [[1, -200]], _pv(cfg), max_new_tokens=2,
+                           temperature=0.7, speculative=2)

@@ -14,6 +14,8 @@ from eventgpt_tpu.models import eventchat
 from eventgpt_tpu.train.args import DataArguments, ModelArguments, TrainingArguments
 from eventgpt_tpu.train.trainer import Trainer
 
+pytestmark = pytest.mark.slow  # heavyweight e2e/mesh tier (-m 'not slow' to skip)
+
 SAMPLE_DIR = "/root/reference/samples"
 
 
