@@ -306,7 +306,8 @@ def run_spec(args):
     plens = jnp.asarray(lens.astype(np.int32))
 
     def prefill_once():
-        cache = llama_mod.init_kv_cache(cfg.llama, 1, cache_len, dtype)
+        cache = llama_mod.init_kv_cache(cfg.llama, 1, cache_len, dtype,
+                                        quant=args.kv == "int8")
         return _prefill_jit(params, cfg, padded, mask, cache, True)
 
     loop = lambda lg, cch: _spec_loop_jit(
@@ -316,6 +317,7 @@ def run_spec(args):
     last, cache = prefill_once()
     out, n_gen, n_iters = loop(last, cache)  # compile
     _sync(out)
+    del out, n_gen, n_iters, last, cache  # 13B int8 + two caches is >16 GB
     last, cache = prefill_once()
     _sync(last)
     t0 = time.perf_counter()
@@ -336,6 +338,7 @@ def run_spec(args):
         # Zero-acceptance bound from the SAME run: one committed token per
         # iteration at the measured (shape-static) iteration cost.
         "floor_tok_s": round(iters / dt, 2),
+        "kv_cache": args.kv,
         "quant": quant,
         "platform": platform,
     }

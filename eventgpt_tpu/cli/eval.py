@@ -66,8 +66,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pretrain_query_embedder", type=str, default=None)
     p.add_argument("--pretrain_attention_layers", type=str, default=None)
     p.add_argument("--speculative", type=int, default=0,
-                   help="speculative decode window (exact greedy equivalence; "
-                        "requires temperature 0, num_beams 1, single chip)")
+                   help="speculative decode window (exact greedy chain at "
+                        "temperature 0, exact sampling distribution above; "
+                        "num_beams must be 1)")
     p.add_argument("--timing", action="store_true")
     return p
 

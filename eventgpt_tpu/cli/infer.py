@@ -83,8 +83,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tensor-parallel axis of the serving mesh")
     p.add_argument("--speculative", type=int, default=0,
                    help="speculative decode window (n-gram draft + K-token "
-                        "verify; exact greedy equivalence — requires "
-                        "temperature 0, num_beams 1, single chip; 0 = off)")
+                        "verify; exact greedy chain at temperature 0, exact "
+                        "sampling distribution above; num_beams must be 1; "
+                        "0 = off)")
     p.add_argument("--timing", action="store_true", help="print stage timings to stderr")
     # Q-Former serving (the use_event_qformer surface): enable the gate and
     # load the trained component artifacts written by the trainer

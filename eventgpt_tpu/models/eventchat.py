@@ -395,9 +395,68 @@ def _beam_loop_jit(
     return tokens[row, best], lengths[row, best]
 
 
+def _spec_probs(logits, temperature: float, top_p: float):
+    """Sampling distribution at each verify position: temperature scaling +
+    nucleus filter, matching the plain path (``ops/sampling.sample``)."""
+    from eventgpt_tpu.ops.sampling import top_p_filter
+
+    scaled = logits.astype(jnp.float32) / temperature
+    if top_p < 1.0:
+        shape = scaled.shape
+        scaled = top_p_filter(scaled.reshape(-1, shape[-1]), top_p).reshape(shape)
+    return jax.nn.softmax(scaled, axis=-1)
+
+
+def _spec_commit_sampled(p, drafts, u, key):
+    """Rejection-sampling acceptance for point-mass (n-gram) drafts.
+
+    ``p``: (B, W, V) target distributions — ``p[:, i]`` is P(next token |
+    window prefix through position i). ``drafts``: (B, W-1) proposed tokens
+    for window positions 1..W-1 (-1 = unmatchable filler, never accepted).
+    ``u``: (B, W-1) uniforms. The draft "distribution" q is a point mass, so
+    draft i+1 is accepted with probability p_i(d) (Leviathan/Chen speculative
+    sampling with degenerate q), and the first rejection resamples from
+    norm(max(p - q, 0)) = p with the rejected token zeroed — the committed
+    chain is exactly distributed as sequential sampling from p.
+
+    Returns (a, corrected): a (B,) accepted-draft count; corrected (B,) the
+    token sampled at the first rejection (or from the final position's p on
+    full acceptance).
+    """
+    b, w, v = p.shape
+    bidx = jnp.arange(b)
+    if w == 1:  # degenerate window: no drafts, sample the one token
+        corrected = jax.random.categorical(
+            key, jnp.log(jnp.maximum(p[:, 0], 1e-38)), axis=-1
+        ).astype(jnp.int32)
+        return jnp.zeros((b,), jnp.int32), corrected
+    d_valid = drafts >= 0
+    d_safe = jnp.clip(drafts, 0, v - 1)
+    p_draft = jnp.where(
+        d_valid,
+        jnp.take_along_axis(p[:, :-1], d_safe[:, :, None], axis=2)[:, :, 0],
+        0.0,
+    )  # (B, W-1): acceptance probability of each draft
+    acc = jnp.cumprod((u < p_draft).astype(jnp.int32), axis=1)
+    a = acc.sum(axis=1)  # (B,) accepted prefix length
+
+    p_a = p[bidx, a]  # (B, V) distribution at the first rejection point
+    # Zero the rejected token's mass (only when a < W-1: full acceptance
+    # samples the bonus token from the untouched final distribution).
+    rej = jnp.where(a < w - 1, d_safe[bidx, jnp.minimum(a, w - 2)], -1)
+    rej_valid = (a < w - 1) & d_valid[bidx, jnp.minimum(a, w - 2)]
+    onehot = jax.nn.one_hot(jnp.maximum(rej, 0), v, dtype=p_a.dtype)
+    p_adj = jnp.where(rej_valid[:, None], p_a * (1.0 - onehot), p_a)
+    corrected = jax.random.categorical(
+        key, jnp.log(jnp.maximum(p_adj, 1e-38)), axis=-1
+    ).astype(jnp.int32)
+    return a, corrected
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "window", "eos_token_id"),
+    static_argnames=("cfg", "max_new_tokens", "window", "eos_token_id",
+                     "temperature", "top_p"),
     donate_argnames=("cache",),
 )
 def _spec_loop_jit(
@@ -410,9 +469,14 @@ def _spec_loop_jit(
     max_new_tokens: int,
     window: int,
     eos_token_id: int,
+    temperature: float = 0.0,
+    top_p: float = 1.0,
+    key=None,
 ):
-    """Greedy speculative decoding: n-gram (prompt-lookup) drafting + one
-    K-token verification forward per iteration.
+    """Speculative decoding: n-gram (prompt-lookup) drafting + one K-token
+    verification forward per iteration. Greedy (temperature 0) or sampled
+    (temperature > 0, nucleus top_p — the reference's default run shape,
+    ``inference.py:19-22``).
 
     Decode at batch 1 is weight-bandwidth-bound (PERFORMANCE.md): one
     ``decode_step`` streams ~3.4 GB of int8 weights to emit ONE token. A
@@ -421,12 +485,17 @@ def _spec_loop_jit(
     weight-streaming pass saved. Drafts come from a bigram match against the
     prompt + generated text (`prompt lookup decoding`: the most recent
     earlier occurrence of the current bigram predicts its continuation) —
-    no draft model, no extra weights, and exact greedy equivalence: a draft
-    is committed only when it equals the verifier's argmax at its position,
-    and the first mismatch is replaced by that argmax (which is itself a
-    committed greedy token). Worst case (no draft ever accepted) each
-    iteration still commits one token — the plain greedy chain at ~decode
-    cost plus the small window overhead.
+    no draft model, no extra weights.
+
+    Correctness contracts: at temperature 0, a draft is committed only when
+    it equals the verifier's argmax at its position and the first mismatch
+    is replaced by that argmax — EXACTLY the plain greedy chain. At
+    temperature > 0, drafts go through rejection sampling against the
+    verifier's distribution (``_spec_commit_sampled``) — the committed chain
+    is EXACTLY DISTRIBUTED as sequential sampling, token for token (not the
+    same stream as the plain loop, which burns its PRNG differently).
+    Worst case (no draft ever accepted) each iteration still commits one
+    token — the plain chain at ~decode cost plus the small window overhead.
 
     ``ids_buf`` is the committed-token buffer: spliced-prompt text ids with
     event-block positions holding -1 (never matchable), generated ids
@@ -443,18 +512,22 @@ def _spec_loop_jit(
     bidx = jnp.arange(b)
     iarr = jnp.arange(window)[None, :]
     eos = eos_token_id
+    sampled = temperature > 0.0  # static: picks the verification rule
+    if key is None:
+        key = jax.random.PRNGKey(0)
 
-    t0 = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
+    key, k0 = jax.random.split(key)
+    t0 = sample(first_logits, k0, temperature, top_p)  # argmax at T=0
     ids_buf0 = ids_buf.at[bidx, prompt_lens].set(t0)
     n_gen0 = jnp.ones((b,), jnp.int32)
     done0 = t0 == eos
 
     def cond(state):
-        _, n_gen, done, _, _ = state
+        _, n_gen, done, _, _, _ = state
         return (~done & (n_gen < max_new_tokens)).any()
 
     def body(state):
-        ids_buf, n_gen, done, cache, n_iters = state
+        ids_buf, n_gen, done, cache, n_iters, key = state
         active = ~done & (n_gen < max_new_tokens)
         pos = prompt_lens + n_gen          # next ids_buf write slot
         c0 = ids_buf[bidx, pos - 1]        # newest committed, KV not cached
@@ -484,14 +557,19 @@ def _spec_loop_jit(
         logits, cache = llama_mod.decode_kstep(
             params["llama"], cfg.llama, embeds, cache
         )
-        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, W) greedy
-
-        # Accepted draft prefix: drafts[:, :a] all equal their greedy target.
-        acc = jnp.cumprod((drafts == g[:, :-1]).astype(jnp.int32), axis=1)
-        a = acc.sum(axis=1)                           # (B,) in [0, W-1]
-        g_a = g[bidx, a]                              # correction token
+        if sampled:
+            key, ku, kc = jax.random.split(key, 3)
+            p = _spec_probs(logits, temperature, top_p)
+            u = jax.random.uniform(ku, (b, window - 1))
+            a, corrected = _spec_commit_sampled(p, drafts, u, kc)
+        else:
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, W)
+            # Accepted prefix: drafts[:, :a] all equal their greedy target.
+            acc = jnp.cumprod((drafts == g[:, :-1]).astype(jnp.int32), axis=1)
+            a = acc.sum(axis=1)                       # (B,) in [0, W-1]
+            corrected = g[bidx, a]
         drafts_p = jnp.concatenate([drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
-        commit = jnp.where(iarr < a[:, None], drafts_p, g_a[:, None])  # (B, W)
+        commit = jnp.where(iarr < a[:, None], drafts_p, corrected[:, None])  # (B, W)
         m_count = a + 1
 
         # EOS stops the commit window at (and including) the EOS token.
@@ -511,10 +589,10 @@ def _spec_loop_jit(
         # (stale slots above length are masked everywhere and overwritten
         # by the next window).
         cache = {**cache, "length": prev_len + m_eff}
-        return ids_buf, n_gen, done, cache, n_iters + 1
+        return ids_buf, n_gen, done, cache, n_iters + 1, key
 
-    ids_buf, n_gen, done, cache, n_iters = lax.while_loop(
-        cond, body, (ids_buf0, n_gen0, done0, cache, jnp.int32(0))
+    ids_buf, n_gen, done, cache, n_iters, _ = lax.while_loop(
+        cond, body, (ids_buf0, n_gen0, done0, cache, jnp.int32(0), key)
     )
     return ids_buf, n_gen, n_iters
 
@@ -529,7 +607,12 @@ def generate(
     top_p: float = 1.0,
     eos_token_id: Optional[int] = 2,
     seed: int = 0,
-    bucket: int = SEQ_BUCKET,
+    # Serving cache grain: 2x the training SEQ_BUCKET — a multiple keeps the
+    # train/serve shape interactions aligned (the reason the constant is
+    # shared) while preserving the coarser serving granularity: halving it
+    # to 64 would double the set of compiled prefill/decode shapes a server
+    # cycles through across prompt lengths (a full XLA compile each).
+    bucket: int = 2 * SEQ_BUCKET,
     max_context: Optional[int] = None,
     num_beams: int = 1,
     kv_quant: bool = False,
@@ -552,6 +635,12 @@ def generate(
     layout: pjit-sharded FSDP/TP weights, HBM-resident sharded cache —
     vs the reference's single-GPU ``inference.py:52-63``).
 
+    ``speculative``: verify-window size K > 0 enables speculative decoding
+    (n-gram draft + K-token verify, ``_spec_loop_jit``) — at temperature 0
+    exactly the plain greedy chain; at temperature > 0 rejection-sampled
+    to the exact sampling distribution. Usually far fewer weight-streaming
+    passes. Composes with ``kv_quant`` and ``mesh``; requires num_beams 1.
+
     ``input_ids_batch``: token ids containing -200 sentinels.
     ``pixel_values_batch``: (B, T_frames, C, H, W).
     """
@@ -559,16 +648,11 @@ def generate(
 
     compute_dtype = jax.tree_util.tree_leaves(params["llama"])[0].dtype
 
-    if speculative:
-        if num_beams > 1:
-            raise ValueError("speculative decoding is greedy-only: num_beams must be 1")
-        if temperature > 0.0:
-            raise ValueError(
-                "speculative decoding requires temperature 0 (greedy); the "
-                "committed chain must equal the verifier's argmax chain"
-            )
-        if mesh is not None:
-            raise ValueError("speculative decoding is single-chip (mesh=None) for now")
+    if speculative and num_beams > 1:
+        raise ValueError(
+            "speculative decoding composes with greedy/sampled decode, "
+            "not beam search: num_beams must be 1"
+        )
 
     serving = None
     if mesh is not None:
@@ -660,10 +744,18 @@ def generate(
         for i, ids in enumerate(input_ids_batch):
             row = _spliced_text_ids(split_at_event(ids), n_ev, limit)
             ids_host[i, : len(row)] = row
+        ids_buf = jnp.asarray(ids_host)
+        plens = jnp.asarray(lens.astype(np.int32))
+        if serving is not None:
+            # Everything in the loop is batch-parallel (per-row scatter
+            # writes, bigram scan, argmax over the model-sharded vocab) —
+            # GSPMD partitions it like the plain decode loop.
+            ids_buf = serving.shard_batch_array(ids_buf, mesh)
+            plens = serving.shard_batch_array(plens, mesh)
         out_buf, n_gen, n_iters = _spec_loop_jit(
-            params, cfg, last_logits, cache,
-            jnp.asarray(ids_host), jnp.asarray(lens.astype(np.int32)),
+            params, cfg, last_logits, cache, ids_buf, plens,
             max_new_tokens, window, int(eos),
+            temperature=float(temperature), top_p=float(top_p), key=key,
         )
         out_np = np.asarray(jax.device_get(out_buf))
         gen_np = np.asarray(jax.device_get(n_gen))

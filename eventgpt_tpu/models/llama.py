@@ -269,6 +269,32 @@ def _kv_is_quant(cache: KVCache) -> bool:
     return isinstance(cache["k"], dict)
 
 
+def _cache_write(buf, li, batch_idx, slots, vals, quant: bool):
+    """Write new K/V rows into layer ``li`` of a cache buffer — THE cache
+    write for both decode paths, so the bf16-vs-int8 handling cannot drift
+    between them. ``slots`` (B,) writes one slot per row (decode_step's hot
+    loop — lowers to an in-place dynamic-update-slice); (B, K) writes a
+    verification window per row (decode_kstep — a scatter). ``vals`` has a
+    matching leading shape + (KV, hd)."""
+    idx = batch_idx if slots.ndim == 1 else batch_idx[:, None]
+    if quant:
+        qs = _kv_quantize(vals)
+        return {"q": buf["q"].at[li, idx, slots].set(qs["q"]),
+                "s": buf["s"].at[li, idx, slots].set(qs["s"])}
+    return buf.at[li, idx, slots].set(vals.astype(buf.dtype))
+
+
+def _cache_read_layer(buf, li, dtype, quant: bool):
+    """Layer ``li`` of a cache buffer as (B, S, KV, hd) in ``dtype``. For the
+    int8 cache the dequant fuses into the attention einsum's operand reads:
+    HBM streams int8 payloads + 1/hd scales instead of bf16."""
+    if quant:
+        leaf = {"q": lax.dynamic_index_in_dim(buf["q"], li, keepdims=False),
+                "s": lax.dynamic_index_in_dim(buf["s"], li, keepdims=False)}
+        return _kv_dequant(leaf, dtype)
+    return lax.dynamic_index_in_dim(buf, li, keepdims=False).astype(dtype)
+
+
 def _kv_quantize(x: jnp.ndarray) -> Dict[str, jnp.ndarray]:
     """(..., hd) -> {"q": int8, "s": f32 (..., 1)}; symmetric per-vector."""
     x32 = x.astype(jnp.float32)
@@ -410,41 +436,24 @@ def decode_step(
     batch_idx = jnp.arange(b)
     quant = _kv_is_quant(cache)
 
-    def write_slot(buf, li, vals):
-        """Write (B, KV, hd) new-token K/V at layer ``li``, each row's slot.
-
-        The cache rides the scan as CARRY (not xs/ys): XLA aliases carry
-        buffers across iterations, so this lowers to an in-place one-slot
-        dynamic-update-slice. The previous xs/ys form restacked the full
-        (L, B, S, KV, hd) k and v buffers every decode step — ~800 MB of
-        pure copy traffic per token at 7B/S=768, measured ~2 ms/token.
-        """
-        if quant:
-            qs = _kv_quantize(vals)
-            return {"q": buf["q"].at[li, batch_idx, slot].set(qs["q"]),
-                    "s": buf["s"].at[li, batch_idx, slot].set(qs["s"])}
-        return buf.at[li, batch_idx, slot].set(vals.astype(buf.dtype))
-
-    def read_layer(buf, li, dtype):
-        # The dequant fuses into the attention einsum's operand reads: HBM
-        # streams int8 + 1/hd scales instead of bf16.
-        if quant:
-            leaf = {"q": lax.dynamic_index_in_dim(buf["q"], li, keepdims=False),
-                    "s": lax.dynamic_index_in_dim(buf["s"], li, keepdims=False)}
-            return _kv_dequant(leaf, dtype)
-        return lax.dynamic_index_in_dim(buf, li, keepdims=False).astype(dtype)
-
+    # The cache rides the scan as CARRY (not xs/ys): XLA aliases carry
+    # buffers across iterations, so the (B,)-slot _cache_write lowers to an
+    # in-place one-slot dynamic-update-slice. The previous xs/ys form
+    # restacked the full (L, B, S, KV, hd) k and v buffers every decode
+    # step — ~800 MB of pure copy traffic per token at 7B/S=768, measured
+    # ~2 ms/token.
     def block(carry, xs):
         h_in, k_buf, v_buf = carry
         layer, li = xs
         y = rms_norm(h_in, layer["input_norm"], cfg.rms_norm_eps)
         q_proj, k_new, v_new = _project_qkv(cfg, y, layer)
         k_new = apply_rope(k_new, cos, sin)
-        k_buf = write_slot(k_buf, li, k_new[:, 0])
-        v_buf = write_slot(v_buf, li, v_new[:, 0])
+        k_buf = _cache_write(k_buf, li, batch_idx, slot, k_new[:, 0], quant)
+        v_buf = _cache_write(v_buf, li, batch_idx, slot, v_new[:, 0], quant)
         h_mid = h_in + _attn_block(cfg, q_proj, layer, cos, sin,
-                                   read_layer(k_buf, li, h_in.dtype),
-                                   read_layer(v_buf, li, h_in.dtype), mask)
+                                   _cache_read_layer(k_buf, li, h_in.dtype, quant),
+                                   _cache_read_layer(v_buf, li, h_in.dtype, quant),
+                                   mask)
         y2 = rms_norm(h_mid, layer["post_norm"], cfg.rms_norm_eps)
         h_out = h_mid + _mlp_block(y2, layer)
         return (h_out, k_buf, v_buf), None
@@ -497,32 +506,18 @@ def decode_kstep(
     batch_idx = jnp.arange(b)
     quant = _kv_is_quant(cache)
 
-    def write_window(buf, li, vals):
-        """Scatter (B, K, KV, hd) new K/V at per-row slots base..base+K-1."""
-        if quant:
-            qs = _kv_quantize(vals)
-            return {"q": buf["q"].at[li, batch_idx[:, None], pos].set(qs["q"]),
-                    "s": buf["s"].at[li, batch_idx[:, None], pos].set(qs["s"])}
-        return buf.at[li, batch_idx[:, None], pos].set(vals.astype(buf.dtype))
-
-    def read_layer(buf, li, dtype):
-        if quant:
-            leaf = {"q": lax.dynamic_index_in_dim(buf["q"], li, keepdims=False),
-                    "s": lax.dynamic_index_in_dim(buf["s"], li, keepdims=False)}
-            return _kv_dequant(leaf, dtype)
-        return lax.dynamic_index_in_dim(buf, li, keepdims=False).astype(dtype)
-
     def block(carry, xs):
         h_in, k_buf, v_buf = carry
         layer, li = xs
         y = rms_norm(h_in, layer["input_norm"], cfg.rms_norm_eps)
         q_proj, k_new, v_new = _project_qkv(cfg, y, layer)
         k_new = apply_rope(k_new, cos, sin)
-        k_buf = write_window(k_buf, li, k_new)
-        v_buf = write_window(v_buf, li, v_new)
+        k_buf = _cache_write(k_buf, li, batch_idx, pos, k_new, quant)
+        v_buf = _cache_write(v_buf, li, batch_idx, pos, v_new, quant)
         h_mid = h_in + _attn_block(cfg, q_proj, layer, cos, sin,
-                                   read_layer(k_buf, li, h_in.dtype),
-                                   read_layer(v_buf, li, h_in.dtype), mask)
+                                   _cache_read_layer(k_buf, li, h_in.dtype, quant),
+                                   _cache_read_layer(v_buf, li, h_in.dtype, quant),
+                                   mask)
         y2 = rms_norm(h_mid, layer["post_norm"], cfg.rms_norm_eps)
         h_out = h_mid + _mlp_block(y2, layer)
         return (h_out, k_buf, v_buf), None

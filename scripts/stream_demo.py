@@ -68,6 +68,9 @@ def main(argv=None):
     # prepare_model surface (parity with cli/infer.py).
     p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
     p.add_argument("--quant", default="none", choices=["none", "int8", "int4"])
+    p.add_argument("--speculative", type=int, default=0,
+                   help="speculative greedy decode window (exact-equivalent; "
+                        "cuts per-answer decode latency when text repeats)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--use_event_qformer", action="store_true")
     p.add_argument("--pretrain_query_embedder", type=str, default=None)
@@ -140,6 +143,7 @@ def main(argv=None):
                         params, cfg, [input_ids], pixels[None],
                         max_new_tokens=args.max_new_tokens, temperature=0.0,
                         eos_token_id=getattr(tokenizer, "eos_token_id", None),
+                        speculative=args.speculative,
                     )[0]
                     answer = tokenizer.batch_decode(
                         [out_ids], skip_special_tokens=True

@@ -243,3 +243,23 @@ def test_sharded_generate_flash_prefill_matches_dense():
         pixels, max_new_tokens=6, temperature=0.0, mesh=mesh,
     )
     assert out == ref
+
+
+def test_sharded_speculative_matches_single_chip():
+    """speculative=K composes with the serving mesh: same tokens as the
+    single-chip speculative run and as plain greedy."""
+    cfg, params, ids, pixels = _setup(batch=2)
+    plain = eventchat.generate(
+        params, cfg, ids, pixels, max_new_tokens=8, temperature=0.0
+    )
+    spec1 = eventchat.generate(
+        params, cfg, ids, pixels, max_new_tokens=8, temperature=0.0,
+        speculative=4,
+    )
+    mesh = _mesh()
+    specm = eventchat.generate(
+        shard_params_for_serving(params, cfg, mesh), cfg, ids, pixels,
+        max_new_tokens=8, temperature=0.0, speculative=4, mesh=mesh,
+    )
+    assert spec1 == plain
+    assert specm == plain

@@ -175,9 +175,122 @@ def test_spec_worst_case_still_exact(tiny):
 
 def test_spec_validation(tiny):
     cfg, params = tiny
-    with pytest.raises(ValueError, match="greedy-only"):
+    with pytest.raises(ValueError, match="num_beams"):
         eventchat.generate(params, cfg, [[1, -200]], _pv(cfg), max_new_tokens=2,
                            num_beams=2, speculative=2)
-    with pytest.raises(ValueError, match="temperature 0"):
-        eventchat.generate(params, cfg, [[1, -200]], _pv(cfg), max_new_tokens=2,
-                           temperature=0.7, speculative=2)
+
+
+# ---- sampled speculative decoding (rejection sampling) ----------------------
+
+
+def test_spec_commit_sampled_oracle():
+    """Acceptance math against hand-crafted distributions and uniforms."""
+    from eventgpt_tpu.models.eventchat import _spec_commit_sampled
+
+    v, w = 8, 4
+    key = jax.random.PRNGKey(0)
+
+    def P(rows):  # (W, V) rows -> (1, W, V)
+        return jnp.asarray(np.asarray(rows, np.float32))[None]
+
+    onehot = lambda t: np.eye(v, dtype=np.float32)[t]
+
+    # All drafts certain (p(d)=1): full acceptance, bonus token from the
+    # final position's (concentrated) distribution.
+    p = P([onehot(3), onehot(5), onehot(6), onehot(2)])
+    a, c = _spec_commit_sampled(p, jnp.asarray([[3, 5, 6]]), jnp.asarray([[0.9, 0.9, 0.9]]), key)
+    assert int(a[0]) == 3 and int(c[0]) == 2
+
+    # First draft impossible (p(d)=0): rejected immediately; resample from
+    # p0 with the rejected token zeroed -> the single remaining mode.
+    p0 = 0.5 * onehot(1) + 0.5 * onehot(4)
+    p = P([p0, onehot(0), onehot(0), onehot(0)])
+    a, c = _spec_commit_sampled(
+        p.at[0, 0, 4].set(0.0).at[0, 0, 1].set(0.5),
+        jnp.asarray([[4, 0, 0]]), jnp.asarray([[0.0, 0.0, 0.0]]), key,
+    )
+    # u=0.0 < p(4)=0.0 is False -> reject; zeroing token 4 leaves token 1.
+    assert int(a[0]) == 0 and int(c[0]) == 1
+
+    # Invalid (-1) drafts are never accepted.
+    p = P([onehot(1), onehot(1), onehot(1), onehot(1)])
+    a, c = _spec_commit_sampled(p, jnp.asarray([[-1, -1, -1]]), jnp.asarray([[0.0, 0.0, 0.0]]), key)
+    assert int(a[0]) == 0 and int(c[0]) == 1
+
+    # Mid-window rejection: accept d1 (p=1), reject d2 (p=0), resample at
+    # position 1 (zeroing d2's token keeps the other mode).
+    p1 = 0.6 * onehot(2) + 0.4 * onehot(7)
+    p = P([onehot(5), p1, onehot(0), onehot(0)])
+    a, c = _spec_commit_sampled(p, jnp.asarray([[5, 7, 0]]),
+                                jnp.asarray([[0.5, 0.5, 0.5]]), key)
+    # p1(7)=0.4, u=0.5 -> reject at i=1; zero token 7 -> mode 2 remains.
+    assert int(a[0]) == 1 and int(c[0]) == 2
+
+
+def test_spec_commit_sampled_is_unbiased():
+    """The first committed token of a verification window is distributed
+    EXACTLY as the target distribution p0, whatever the (point-mass) draft —
+    the definitional property of rejection-sampling speculation. Checked
+    empirically with 20k vectorized windows against the analytic marginal."""
+    from eventgpt_tpu.models.eventchat import _spec_commit_sampled
+
+    v, w, n = 8, 3, 20000
+    rng = np.random.default_rng(0)
+    p0 = rng.dirichlet(np.ones(v)).astype(np.float32)
+    p1 = rng.dirichlet(np.ones(v)).astype(np.float32)
+    p = jnp.asarray(np.broadcast_to(np.stack([p0, p1, p1]), (n, w, v)).copy())
+    for draft_tok in (int(np.argmax(p0)), int(np.argmin(p0))):
+        drafts = jnp.full((n, w - 1), draft_tok, jnp.int32)
+        u = jax.random.uniform(jax.random.PRNGKey(1), (n, w - 1))
+        a, corrected = _spec_commit_sampled(p, drafts, u, jax.random.PRNGKey(2))
+        first = np.where(np.asarray(a) >= 1, draft_tok, np.asarray(corrected))
+        emp = np.bincount(first, minlength=v) / n
+        l1 = np.abs(emp - p0).sum()
+        assert l1 < 0.05, f"draft {draft_tok}: L1 {l1:.3f}"
+
+
+def test_spec_sampled_e2e_marginals_smoke(tiny):
+    """End-to-end sampled spec vs plain sampling: same per-seed FIRST token
+    (identical PRNG consumption) and statistically compatible later
+    marginals. The tight unbiasedness proof is the vectorized test above;
+    across n seeds two independent same-distribution draws differ by
+    E[L1] ~ sqrt(2*support/(pi*n)) per token summed — the bound here is
+    sized for that noise, not for precision."""
+    cfg, params = tiny
+    ids = [1, 5, -200, 9, 9, 31]
+    pv = _pv(cfg)
+    n, steps = 100, 2
+    plain_t, spec_t = [], []
+    for seed in range(n):
+        plain_t.append(eventchat.generate(
+            params, cfg, [ids], pv, max_new_tokens=steps,
+            temperature=0.4, top_p=0.9, eos_token_id=None, seed=seed,
+        )[0])
+        spec_t.append(eventchat.generate(
+            params, cfg, [ids], pv, max_new_tokens=steps,
+            temperature=0.4, top_p=0.9, eos_token_id=None, seed=seed,
+            speculative=3,
+        )[0])
+    assert [c[0] for c in plain_t] == [c[0] for c in spec_t]
+    v = cfg.llama.vocab_size
+    hp = np.bincount([c[1] for c in plain_t], minlength=v) / n
+    hs = np.bincount([c[1] for c in spec_t], minlength=v) / n
+    assert np.abs(hp - hs).sum() < 1.2
+
+
+def test_spec_sampled_full_budget_and_eos(tiny):
+    """Sampled spec path: EOS stop + budget cap behave like plain decode."""
+    cfg, params = tiny
+    ids = [1, 5, -200, 9]
+    out = eventchat.generate(
+        params, cfg, [ids], _pv(cfg), max_new_tokens=10,
+        temperature=0.7, eos_token_id=None, speculative=4, seed=3,
+    )[0]
+    assert len(out) == 10
+    eos = out[4]
+    stopped = eventchat.generate(
+        params, cfg, [ids], _pv(cfg), max_new_tokens=10,
+        temperature=0.7, eos_token_id=eos, speculative=4, seed=3,
+    )[0]
+    assert len(stopped) <= 10
+    assert eos not in stopped
