@@ -402,8 +402,7 @@ def _spec_probs(logits, temperature: float, top_p: float):
 
     scaled = logits.astype(jnp.float32) / temperature
     if top_p < 1.0:
-        shape = scaled.shape
-        scaled = top_p_filter(scaled.reshape(-1, shape[-1]), top_p).reshape(shape)
+        scaled = top_p_filter(scaled, top_p)  # rank-agnostic (axis=-1 ops)
     return jax.nn.softmax(scaled, axis=-1)
 
 
@@ -715,9 +714,10 @@ def generate(
     # (an out-of-vocab sentinel that never matches a sampled token).
     eos = eos_token_id if eos_token_id is not None else -1
     if num_beams > 1:
-        # Bucketed down (same 64-grain as the cache length): gather_start is
-        # a STATIC jit arg, and an exact lens.min() would recompile the
-        # whole beam loop per distinct prompt length.
+        # Bucketed down to the SEQ_BUCKET grain (a lower bound on lens.min()
+        # is all correctness needs): gather_start is a STATIC jit arg, and
+        # an exact lens.min() would recompile the whole beam loop per
+        # distinct prompt length.
         tokens, lengths = _beam_loop_jit(
             params, cfg, last_logits, cache, int(num_beams),
             max_new_tokens, int(eos),

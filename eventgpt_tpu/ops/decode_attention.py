@@ -1,0 +1,154 @@
+"""Pallas fused int8-KV decode attention (single query over the HBM cache).
+
+Why this kernel exists: batch-1 decode at 7B streams the whole weight set
+per token (PERFORMANCE.md), and the KV cache is the next-largest stream —
+~0.5-0.7 GB/token bf16 at the reference's 512-token budget. The int8 cache
+halves those bytes, but through plain XLA the dequantize (int8 * f32 scale
+-> bf16) costs more VPU time than the bandwidth it saves: measured a WASH
+at batch 1 (12.3 vs 11.9 ms/token, PERFORMANCE.md negative results). This
+kernel performs the dequant in VMEM fused into the attention dots, so HBM
+traffic actually drops to the int8 payload + per-vector scales and the
+wash becomes a win.
+
+Shape/layout contract (matches ``models/llama.py`` cache layout):
+  * cache buffers: (L, B, S, KV, hd) int8 payload, (L, B, S, KV, 1) f32
+    scales — the kernel receives the FULL stacked-layer buffer and selects
+    the layer with a scalar-prefetched index (``PrefetchScalarGridSpec``),
+    so the surrounding ``lax.scan`` over layers never materializes a
+    per-layer slice copy.
+  * q: (B, KV, G, hd) — post-RoPE query heads regrouped per KV head
+    (G = H // KV, GQA-aware without repeating K/V).
+  * n_valid: (B,) int32 — slots [0, n_valid) are attendable (the caller has
+    already written the current token's K/V at slot n_valid-1).
+
+Grid: (B, KV); each cell computes (G, hd) of output from one row's one KV
+head: dequantized (S, hd) K/V tiles live only in VMEM. S is padded to a
+lane multiple by the caller (cache lengths are bucket-aligned already).
+
+On non-TPU backends the kernel runs in interpreter mode (CPU-mesh tests),
+like ``ops/flash_attention.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _decode_attn_kernel(li_ref, nv_ref, q_ref, kq_ref, ks_ref, vq_ref,
+                        vs_ref, o_ref, *, scale: float, block_kv: int):
+    """One (batch row, KV-head group) cell: dequant + masked attention.
+
+    Block refs (layer axis dropped by its None block dim): q
+    (1, block_kv, G, hd); payloads (1, S, block_kv, hd); scales
+    (1, S, block_kv, 1). TPU tiling wants the last two block dims
+    (divisible-by-8, 128-multiple-or-full), which is why KV rides in
+    groups of ``block_kv`` and the head loop is unrolled here instead of
+    gridded.
+    """
+    b = pl.program_id(0)
+    nv = nv_ref[b]
+
+    for h in range(block_kv):
+        # Scales are per cache ROW (one f32 per (slot, head)), so they
+        # commute past the hd-contraction: score[g,j] = (q . k8[j]) * ks[j],
+        # and p @ (v8 * vs) = (p * vs^T) @ v8. Applying them post-dot means
+        # the only VMEM temps are bf16 casts of the int8 payloads (int8
+        # values are exactly representable in bf16) instead of f32
+        # dequantized planes — that difference is what fits the kernel in
+        # scoped VMEM at S ~ 1200.
+        q = q_ref[0, h].astype(jnp.bfloat16)                     # (G, hd)
+        k8 = kq_ref[0, :, h, :].astype(jnp.bfloat16)             # (S, hd)
+        s = jax.lax.dot_general(
+            q, k8, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (ks_ref[0, :, h].reshape(1, -1) * scale)             # (G, S)
+
+        g, s_len = s.shape
+        j = jax.lax.broadcasted_iota(jnp.int32, (g, s_len), 1)
+        s = jnp.where(j < nv, s, NEG_INF)
+
+        m = s.max(axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = p.sum(axis=-1, keepdims=True)
+        pv = (p * vs_ref[0, :, h].reshape(1, -1)).astype(jnp.bfloat16)
+        v8 = vq_ref[0, :, h, :].astype(jnp.bfloat16)             # (S, hd)
+        o = jax.lax.dot_general(
+            pv, v8, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) / jnp.maximum(l, 1e-30)
+        o_ref[0, h] = o.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def decode_attention_int8(
+    q: jnp.ndarray,       # (B, KV, G, hd) post-RoPE queries
+    k_q: jnp.ndarray,     # (L, B, S, KV, hd) int8
+    k_s: jnp.ndarray,     # (L, B, S, KV, 1) f32
+    v_q: jnp.ndarray,     # (L, B, S, KV, hd) int8
+    v_s: jnp.ndarray,     # (L, B, S, KV, 1) f32
+    li: jnp.ndarray,      # scalar int32 layer index
+    n_valid: jnp.ndarray,  # (B,) int32 attendable slot count
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Returns (B, KV, G, hd) attention context in q.dtype."""
+    b, kv, g, hd = q.shape
+    _, _, s, _, _ = k_q.shape
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    scale = 1.0 / math.sqrt(hd)
+    # KV-head group per grid cell: last-two block-dim tiling wants the KV
+    # block divisible by 8 (or the full axis); 8 keeps VMEM per cell at
+    # ~2.4 MB of int8 payload for S~1152.
+    block_kv = 8 if kv % 8 == 0 else kv
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # (li, n_valid)
+        grid=(b, kv // block_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_kv, g, hd),
+                         lambda bi, hi, li_r, nv_r: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, 1, s, block_kv, hd),
+                         lambda bi, hi, li_r, nv_r: (li_r[0], bi, 0, hi, 0)),
+            pl.BlockSpec((None, 1, s, block_kv, 1),
+                         lambda bi, hi, li_r, nv_r: (li_r[0], bi, 0, hi, 0)),
+            pl.BlockSpec((None, 1, s, block_kv, hd),
+                         lambda bi, hi, li_r, nv_r: (li_r[0], bi, 0, hi, 0)),
+            pl.BlockSpec((None, 1, s, block_kv, 1),
+                         lambda bi, hi, li_r, nv_r: (li_r[0], bi, 0, hi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_kv, g, hd),
+                               lambda bi, hi, li_r, nv_r: (bi, hi, 0, 0)),
+    )
+    return pl.pallas_call(
+        functools.partial(_decode_attn_kernel, scale=scale, block_kv=block_kv),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kv, g, hd), q.dtype),
+        interpret=interpret,
+        # Double-buffered int8 blocks + per-head cast temps exceed the 16 MB
+        # default scoped-VMEM budget at S ~ 1200; v5e has 128 MB VMEM.
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024
+        ),
+    )(jnp.asarray(li, jnp.int32).reshape(1), jnp.asarray(n_valid, jnp.int32),
+      q, k_q, k_s, v_q, v_s)
+
+
+def decode_attention_int8_reference(q, k_q, k_s, v_q, v_s, li, n_valid):
+    """Plain-XLA semantics twin (dequant-then-attend) for tests."""
+    b, kv, g, hd = q.shape
+    k = (k_q[li].astype(jnp.float32) * k_s[li])  # (B, S, KV, hd)
+    v = (v_q[li].astype(jnp.float32) * v_s[li])
+    s = jnp.einsum("bkgd,bskd->bkgs", q.astype(jnp.float32), k) / math.sqrt(hd)
+    mask = jnp.arange(k.shape[1])[None, None, None, :] < n_valid[:, None, None, None]
+    s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgs,bskd->bkgd", p, v)
+    return o.astype(q.dtype)

@@ -1,0 +1,78 @@
+"""Fused int8-KV decode-attention kernel: numerical parity.
+
+The kernel itself is a measured NEGATIVE result for the product path
+(PERFORMANCE.md: 10.1 ms vs 3.7 ms for the XLA fused-dequant attention at
+7B shapes — decode attention inside the sequential layer scan is
+op-granularity-bound, not dequant-bound), kept in-tree with the
+measurement. These tests pin its correctness in interpreter mode so the
+record stays reproducible.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgpt_tpu.ops.decode_attention import (
+    decode_attention_int8,
+    decode_attention_int8_reference,
+)
+
+
+def _case(L=3, B=2, S=128, KV=4, G=2, hd=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.normal(size=(B, KV, G, hd)), jnp.float32),
+        jnp.asarray(rng.integers(-127, 128, (L, B, S, KV, hd)), jnp.int8),
+        jnp.asarray(rng.uniform(0.001, 0.02, (L, B, S, KV, 1)), jnp.float32),
+        jnp.asarray(rng.integers(-127, 128, (L, B, S, KV, hd)), jnp.int8),
+        jnp.asarray(rng.uniform(0.001, 0.02, (L, B, S, KV, 1)), jnp.float32),
+    )
+
+
+@pytest.mark.parametrize("li", [0, 2])
+def test_kernel_matches_reference(li):
+    q, kq, ks, vq, vs = _case()
+    nv = jnp.asarray([37, 100], jnp.int32)
+    out = decode_attention_int8(q, kq, ks, vq, vs, li, nv)
+    ref = decode_attention_int8_reference(q, kq, ks, vq, vs, li, nv)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,  # bf16 post-dot-scaling vs f32 dequant
+    )
+
+
+def test_kernel_full_kv_block():
+    # KV not divisible by 8 -> the whole axis rides one block.
+    q, kq, ks, vq, vs = _case(KV=4, G=1)
+    nv = jnp.asarray([5, 128], jnp.int32)
+    out = decode_attention_int8(q, kq, ks, vq, vs, 1, nv)
+    ref = decode_attention_int8_reference(q, kq, ks, vq, vs, 1, nv)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_kernel_mask_excludes_stale_slots():
+    """Slots >= n_valid must not contribute: poisoning them changes nothing."""
+    q, kq, ks, vq, vs = _case(B=1)
+    nv = jnp.asarray([40], jnp.int32)
+    out = decode_attention_int8(q, kq, ks, vq, vs, 0, nv)
+    kq2 = kq.at[:, :, 40:].set(127)
+    vs2 = vs.at[:, :, 40:].set(1e3)
+    out2 = decode_attention_int8(q, kq2, ks, vq, vs2, 0, nv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2))
+
+
+def test_kernel_multi_block_grid():
+    """KV=16 -> block_kv=8, grid=(B, 2): exercises the hi block-offset maps
+    (a wrong offset would corrupt heads 8..15 only at multi-block shapes)."""
+    q, kq, ks, vq, vs = _case(KV=16, G=2, S=64, hd=32)
+    nv = jnp.asarray([20, 64], jnp.int32)
+    out = decode_attention_int8(q, kq, ks, vq, vs, 1, nv)
+    ref = decode_attention_int8_reference(q, kq, ks, vq, vs, 1, nv)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
