@@ -1,0 +1,313 @@
+"""Continuous-batching serving loop (iteration-level request scheduling).
+
+The reference serves one request per process (``inference.py`` — load,
+generate, print; its ``dataset/constants.py:1-4`` controller/worker
+heartbeat constants are vestiges of a LLaVA serving stack that never
+shipped). This module is the serving runtime the reference implies but
+lacks: a fixed-shape decode batch whose ROWS are a resource — requests
+join a running batch as rows free up, instead of waiting for the whole
+batch to drain.
+
+TPU-shaped design (everything jit-visible is static-shape):
+
+  * One KV cache of (max_batch, max_len) rows lives in HBM for the life of
+    the server; rows are FREE or ACTIVE.
+  * Admission: a batch-1 prefill at the prompt's bucketed length, then the
+    row's prompt KV/logits are written into the shared cache at the free
+    row index (``_admit_row_jit`` — a per-buffer dynamic-update on the
+    batch axis). One prefill executable per prompt bucket, reused forever.
+  * Decode runs in fixed ``chunk``-token segments (``_decode_segment_jit``:
+    the whole-budget ``lax.while_loop`` of ``_decode_loop_jit`` with
+    per-row budgets and a frozen mask). Between segments the host harvests
+    finished rows and admits queued requests — the segment size is the
+    scheduling latency, and at 32 tokens the extra dispatch overhead is
+    ~2-3% of decode (PERFORMANCE.md: whole-budget vs 64-token budgets).
+  * Frozen/free rows keep flowing through the fused step (a ``lax.cond``
+    skip would break the donated cache aliasing — same reasoning as
+    ``_decode_loop_jit``); their writes land above their frozen lengths
+    (clamped at the last slot), are masked out of every attention read,
+    and are overwritten when the row is re-admitted.
+
+Greedy equivalence: rows are independent in attention (per-row lengths,
+positions, masks), so a request decoded in a shared batch commits the same
+greedy chain as ``eventchat.generate`` run alone — tested exactly on the
+CPU f32 suite (``tests/test_serve.py``); on TPU bf16 the usual
+batch-tiling numerics apply.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.constants import SEQ_BUCKET
+from eventgpt_tpu.models import eventchat, llama as llama_mod
+from eventgpt_tpu.ops.sampling import sample
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "chunk", "eos_token_id", "temperature", "top_p"),
+    donate_argnames=("cache",),
+)
+def _decode_segment_jit(
+    params,
+    cfg: EventChatConfig,
+    logits,          # (B, V) per-row next-token logits
+    cache,
+    key,
+    frozen,          # (B,) bool — FREE rows or rows already finished
+    n_rem,           # (B,) int32 remaining token budget per row
+    chunk: int,
+    eos_token_id: int,
+    temperature: float = 0.0,
+    top_p: float = 1.0,
+):
+    """Up to ``chunk`` decode steps over the shared batch.
+
+    Returns (tokens (B, chunk), n_new (B,), done (B,), logits, cache, key):
+    ``tokens[r, :n_new[r]]`` are row r's newly committed tokens;
+    ``done[r]`` marks rows that hit EOS inside this segment (budget
+    exhaustion is the host's bookkeeping via n_rem - n_new == 0).
+    """
+    b = logits.shape[0]
+    tokens0 = jnp.full((b, chunk), eos_token_id, jnp.int32)
+    n_new0 = jnp.zeros((b,), jnp.int32)
+    done0 = jnp.zeros((b,), bool)
+
+    def cond(state):
+        t, _, n_new, done, _, _, _ = state
+        live = ~(frozen | done) & (n_new < n_rem)
+        return (t < chunk) & live.any()
+
+    def body(state):
+        t, tokens, n_new, done, logits, cache, key = state
+        key, sub = jax.random.split(key)
+        nxt = sample(logits, sub, temperature, top_p)
+        commit = ~(frozen | done) & (n_new < n_rem)
+        nxt = jnp.where(commit, nxt, eos_token_id)
+        tokens = tokens.at[:, t].set(jnp.where(commit, nxt, tokens[:, t]))
+        n_new = n_new + commit.astype(jnp.int32)
+        done = done | (commit & (nxt == eos_token_id))
+
+        # Unconditional advance preserves donated-cache aliasing through the
+        # while_loop (see _decode_loop_jit). Frozen rows' slot writes clamp
+        # at the last slot and stay masked out of attention reads.
+        emb = llama_mod.embed_tokens(params["llama"], nxt[:, None])
+        new_logits, cache = llama_mod.decode_step(
+            params["llama"], cfg.llama, emb, cache
+        )
+        # Frozen rows keep their pre-segment logits AND their length: the
+        # row must resume exactly where it stopped when the next segment
+        # runs (length would otherwise creep by one per segment step).
+        logits = jnp.where(commit[:, None], new_logits, logits)
+        cache = {**cache, "length": jnp.where(
+            commit, cache["length"], cache["length"] - 1
+        )}
+        return t + 1, tokens, n_new, done, logits, cache, key
+
+    t, tokens, n_new, done, logits, cache, key = lax.while_loop(
+        cond, body, (jnp.int32(0), tokens0, n_new0, done0, logits, cache, key)
+    )
+    return tokens, n_new, done, logits, cache, key
+
+
+@functools.partial(jax.jit, donate_argnames=("cache", "logits_buf"))
+def _admit_row_jit(cache, logits_buf, row, row_cache, row_logits):
+    """Insert a batch-1 prefill result at batch row ``row`` of the shared
+    cache (dynamic-update on the batch axis; the prompt bucket length of
+    ``row_cache`` is a static shape — one compile per bucket)."""
+
+    def ins(buf, rbuf):
+        if isinstance(buf, dict):
+            return {"q": ins(buf["q"], rbuf["q"]), "s": ins(buf["s"], rbuf["s"])}
+        return lax.dynamic_update_slice(
+            buf, rbuf.astype(buf.dtype),
+            (0, row, 0) + (0,) * (buf.ndim - 3),
+        )
+
+    new_cache = {
+        "k": ins(cache["k"], row_cache["k"]),
+        "v": ins(cache["v"], row_cache["v"]),
+        "length": cache["length"].at[row].set(row_cache["length"][0]),
+    }
+    return new_cache, logits_buf.at[row].set(row_logits[0])
+
+
+@dataclass
+class _Request:
+    rid: int
+    input_ids: Sequence[int]
+    pixel_values: Any
+    max_new_tokens: int
+    tokens: List[int] = field(default_factory=list)
+    row: int = -1
+
+
+class ContinuousBatcher:
+    """Row-level continuous batching over one resident KV cache.
+
+    >>> srv = ContinuousBatcher(params, cfg, max_batch=4, max_len=1024)
+    >>> rid = srv.submit(input_ids, pixel_values, max_new_tokens=64)
+    >>> answers = srv.run_until_drained()   # {rid: [token ids]}
+
+    Greedy by default (temperature 0); sampling configs apply serverwide.
+    Single-chip for now — the serving-mesh path (parallel/serving.py)
+    composes with one-shot ``generate``.
+    """
+
+    def __init__(
+        self,
+        params,
+        cfg: EventChatConfig,
+        max_batch: int = 4,
+        max_len: int = 1024,
+        chunk: int = 32,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        eos_token_id: Optional[int] = 2,
+        seed: int = 0,
+    ):
+        self.params, self.cfg = params, cfg
+        # Admission pads prompts to the serving bucket grain; a max_len off
+        # the grain would let a bucketed row_cache outgrow the shared cache
+        # (a trace-time shape crash). Round up once here.
+        grain = 2 * SEQ_BUCKET
+        max_len = ((max_len + grain - 1) // grain) * grain
+        self.max_batch, self.max_len, self.chunk = max_batch, max_len, chunk
+        self.temperature, self.top_p = float(temperature), float(top_p)
+        self.eos = eos_token_id if eos_token_id is not None else -1
+        self.eos_token_id = eos_token_id
+        self._dtype = jax.tree_util.tree_leaves(params["llama"])[0].dtype
+        if self._dtype not in (jnp.bfloat16, jnp.float32):
+            self._dtype = jnp.bfloat16  # quantized tree: compute in bf16
+        self.cache = llama_mod.init_kv_cache(
+            cfg.llama, max_batch, max_len, dtype=self._dtype
+        )
+        # Vocab from the actual lm_head leaf, not cfg: special-token
+        # registration can grow the embeddings past cfg.llama.vocab_size
+        # (prepare_model's resize). int4 leaves pack K/2 on the
+        # second-to-last dim; the vocab (last) dim is unpacked either way.
+        head = params["llama"]["lm_head"]
+        vocab = (head.get("q", head.get("q4"))
+                 if isinstance(head, dict) else head).shape[-1]
+        self.logits = jnp.zeros((max_batch, vocab), jnp.float32)
+        self.key = jax.random.PRNGKey(seed)
+        self.frozen = np.ones((max_batch,), bool)   # all rows FREE
+        self.n_rem = np.zeros((max_batch,), np.int64)
+        self.rows: List[Optional[_Request]] = [None] * max_batch
+        self.queue: deque[_Request] = deque()
+        self.finished: Dict[int, List[int]] = {}
+        self._next_rid = 0
+
+    # -- client surface ---------------------------------------------------
+
+    def submit(self, input_ids: Sequence[int], pixel_values,
+               max_new_tokens: int = 64) -> int:
+        """Enqueue one request; raises immediately if it cannot fit, so one
+        oversized request never tears down the serving loop mid-drain."""
+        from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
+
+        ids = list(input_ids)
+        n_text = sum(1 for t in ids if t != EVENT_TOKEN_INDEX)
+        n_ev = sum(1 for t in ids if t == EVENT_TOKEN_INDEX)
+        prompt_len = min(
+            n_text + n_ev * self.cfg.num_event_tokens,
+            self.cfg.llama.max_seq_len,
+        )
+        if prompt_len + max_new_tokens + 1 > self.max_len:
+            raise ValueError(
+                f"request does not fit: prompt {prompt_len} + budget "
+                f"{max_new_tokens} exceeds server max_len {self.max_len}"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self.queue.append(_Request(rid, ids, pixel_values, max_new_tokens))
+        return rid
+
+    def run_until_drained(self) -> Dict[int, List[int]]:
+        while self.queue or any(r is not None for r in self.rows):
+            self.step()
+        out, self.finished = self.finished, {}
+        return out
+
+    # -- scheduler core ---------------------------------------------------
+
+    def step(self) -> None:
+        """One scheduling iteration: admit into free rows, run one decode
+        segment, harvest finished rows."""
+        self._admit()
+        if all(r is None for r in self.rows):
+            return
+        frozen = jnp.asarray(self.frozen)
+        n_rem = jnp.asarray(self.n_rem.astype(np.int32))
+        tokens, n_new, done, self.logits, self.cache, self.key = (
+            _decode_segment_jit(
+                self.params, self.cfg, self.logits, self.cache, self.key,
+                frozen, n_rem, self.chunk, int(self.eos),
+                self.temperature, self.top_p,
+            )
+        )
+        tokens = np.asarray(jax.device_get(tokens))
+        n_new = np.asarray(jax.device_get(n_new))
+        done = np.asarray(jax.device_get(done))
+        for r, req in enumerate(self.rows):
+            if req is None or self.frozen[r]:
+                continue
+            req.tokens.extend(int(t) for t in tokens[r, : n_new[r]])
+            self.n_rem[r] -= int(n_new[r])
+            if done[r] or self.n_rem[r] <= 0:
+                ids = req.tokens
+                if (self.eos_token_id is not None and ids
+                        and ids[-1] == self.eos_token_id):
+                    ids = ids[:-1]
+                self.finished[req.rid] = ids
+                self.rows[r] = None
+                self.frozen[r] = True
+
+    def _admit(self) -> None:
+        from eventgpt_tpu.data.tokenizer import split_at_event
+        from eventgpt_tpu.models.eventchat import (
+            _pad_batch, _prefill_jit, splice_embeddings,
+        )
+
+        while self.queue and any(self.rows[r] is None
+                                 for r in range(self.max_batch)):
+            req = self.queue.popleft()
+            row = next(r for r in range(self.max_batch)
+                       if self.rows[r] is None)
+            pv = jnp.asarray(req.pixel_values, self._dtype)
+            ev = eventchat.encode_events_batch(self.params, self.cfg, pv[None])
+            embeds = [splice_embeddings(
+                self.params, self.cfg, split_at_event(req.input_ids), ev[0]
+            )]
+            padded, mask, lens = _pad_batch(embeds)
+            prompt_len = int(lens[0])
+            bucket = 2 * SEQ_BUCKET
+            # submit() validated the fit and max_len is grain-aligned, so
+            # the bucketed prompt can never outgrow the shared cache.
+            s1 = min(((prompt_len + bucket - 1) // bucket) * bucket,
+                     self.max_len)
+            padded = jnp.pad(padded, ((0, 0), (0, s1 - prompt_len), (0, 0)))
+            mask = jnp.pad(mask, ((0, 0), (0, s1 - prompt_len)))
+            row_cache = llama_mod.init_kv_cache(
+                self.cfg.llama, 1, s1, dtype=self._dtype
+            )
+            row_logits, row_cache = _prefill_jit(
+                self.params, self.cfg, padded, mask, row_cache, True
+            )
+            self.cache, self.logits = _admit_row_jit(
+                self.cache, self.logits, row, row_cache, row_logits
+            )
+            self.rows[row] = req
+            req.row = row
+            self.frozen[row] = False
+            self.n_rem[row] = req.max_new_tokens

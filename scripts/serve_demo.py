@@ -1,0 +1,89 @@
+"""Continuous-batching server demo: N event-QA requests through one
+resident decode batch (``eventgpt_tpu/serve.py``).
+
+The reference answers one request per process (``inference.py``); here
+requests join a running batch as rows free up — submit more queries than
+``--max_batch`` and watch them stream through without a batch drain.
+
+Usage (offline smoke, tiny random weights):
+  python scripts/serve_demo.py --event_frame /root/reference/samples/sample1.npy \
+      --queries "What is happening?;Describe the scene.;What moves fastest?" \
+      --max_batch 2 --max_new_tokens 24
+Real checkpoints: --model_path <hf dir> (same loader as cli/infer.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model_path", default="tiny-random")
+    p.add_argument("--tokenizer_path", default=None)
+    p.add_argument("--event_frame", required=True)
+    p.add_argument("--queries", required=True,
+                   help="';'-separated natural-language questions")
+    p.add_argument("--conv_mode", default="eventgpt_v1")
+    p.add_argument("--max_batch", type=int, default=2)
+    p.add_argument("--max_len", type=int, default=1024)
+    p.add_argument("--chunk", type=int, default=32)
+    p.add_argument("--max_new_tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=["bfloat16", "float32"])
+    p.add_argument("--quant", default="none", choices=["none", "int8", "int4"])
+    p.add_argument("--seed", type=int, default=0)
+    # prepare_model (shared with the infer/eval CLIs) reads these:
+    p.add_argument("--use_event_qformer", action="store_true")
+    p.add_argument("--pretrain_query_embedder", default=None)
+    p.add_argument("--pretrain_attention_layers", default=None)
+    args = p.parse_args(argv)
+
+    from eventgpt_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    from eventgpt_tpu.cli.infer import load_model, prepare_model
+    from eventgpt_tpu.data.conversation import prepare_event_prompt
+    from eventgpt_tpu.data.tokenizer import tokenize_with_event
+    from eventgpt_tpu.ops.image import process_event_file
+    from eventgpt_tpu.serve import ContinuousBatcher
+
+    cfg, params, tokenizer = load_model(
+        args.model_path, args.dtype, None, args.tokenizer_path
+    )
+    cfg, params = prepare_model(cfg, params, tokenizer, args)
+    _, pixels = process_event_file(
+        args.event_frame, cfg.num_event_frames, cfg.vision.image_size
+    )
+
+    srv = ContinuousBatcher(
+        params, cfg, max_batch=args.max_batch, max_len=args.max_len,
+        chunk=args.chunk, temperature=args.temperature,
+        eos_token_id=getattr(tokenizer, "eos_token_id", None),
+    )
+    queries = [q for q in args.queries.split(";") if q.strip()]
+    t0 = time.perf_counter()
+    rids = {}
+    for q in queries:
+        ids = tokenize_with_event(
+            prepare_event_prompt(q.strip(), args.conv_mode), tokenizer
+        )
+        rids[srv.submit(ids, pixels, args.max_new_tokens)] = q.strip()
+    out = srv.run_until_drained()
+    dt = time.perf_counter() - t0
+    tot = 0
+    for rid, q in rids.items():
+        answer = tokenizer.batch_decode([out[rid]],
+                                        skip_special_tokens=True)[0].strip()
+        tot += len(out[rid])
+        print(f"Q: {q}\nA: {answer}\n")
+    print(f"[{len(queries)} requests, {tot} tokens, {dt:.2f}s, "
+          f"{tot / dt:.1f} tok/s aggregate]")
+    return out
+
+
+if __name__ == "__main__":
+    main()
