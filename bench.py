@@ -22,7 +22,7 @@ Each leg runs in its own subprocess: HBM is returned between legs (7B
 int8 + 13B int8 cannot coexist on a 16 GB chip) and the warm-start
 numbers are honest second-process measurements by construction.
 
-Modes for manual use: --mode decode|train|warm_probe with
+Modes for manual use: --mode decode|train|warm_probe|spec|serve with
 --preset {auto,7b,13b,tiny} --decode_tokens N --batch N
 --quant {int8,int4,bf16} --kv {bf16,int8} --sweep --seq N --steps N.
 
@@ -346,6 +346,56 @@ def run_spec(args):
     return record
 
 
+def run_serve(args):
+    """Continuous-batching leg: N requests through the resident decode
+    batch (``eventgpt_tpu/serve.py``) vs the sequential-serving rate.
+    Manual-reproduction mode (not part of --mode all): the measurement
+    lives in PERFORMANCE.md."""
+    import jax.numpy as jnp
+
+    from eventgpt_tpu.serve import ContinuousBatcher
+
+    preset, cfg, platform = _resolve_preset(args)
+    dtype = jnp.bfloat16
+    quant = args.quant if preset in ("7b", "13b") else "bf16"
+    params = _build_params(cfg, dtype, quant)
+    pixels = _event_pixels(cfg, 1)[0]
+    ids = [1] + [7] * 34 + [-200] + [9] * 16
+
+    n_req = args.serve_requests
+    srv = ContinuousBatcher(
+        params, cfg, max_batch=args.serve_batch,
+        max_len=((35 + cfg.num_event_tokens + 16 + args.decode_tokens + 128)
+                 // 128) * 128,
+        chunk=args.serve_chunk, eos_token_id=None,
+        kv_quant=args.kv == "int8",
+    )
+    srv.submit(ids, pixels, 8)
+    srv.run_until_drained()  # compile warmup (prefill bucket + segment)
+
+    t0 = time.perf_counter()
+    for _ in range(n_req):
+        srv.submit(ids, pixels, args.decode_tokens)
+    out = srv.run_until_drained()
+    dt = time.perf_counter() - t0
+    tot = sum(len(v) for v in out.values())
+    record = {
+        "metric": f"serve_aggregate_{preset}",
+        "value": round(tot / dt, 2),
+        "unit": "tok/s",
+        "requests": n_req,
+        "tokens": tot,
+        "max_batch": srv.max_batch,
+        "chunk": args.serve_chunk,
+        "decode_tokens": args.decode_tokens,
+        "kv_cache": args.kv,
+        "quant": quant,
+        "platform": platform,
+    }
+    print(json.dumps(record))
+    return record
+
+
 def run_warm_probe(args):
     """Cold-start probe: encode + prefill first-call latency in THIS process.
 
@@ -552,9 +602,17 @@ def run_all(args):
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--mode", default="all",
-                   choices=["all", "decode", "train", "warm_probe", "spec"])
+                   choices=["all", "decode", "train", "warm_probe", "spec",
+                            "serve"])
     p.add_argument("--spec_window", type=int, default=8,
                    help="speculative verify window (mode=spec)")
+    p.add_argument("--serve_requests", type=int, default=8,
+                   help="requests for mode=serve")
+    p.add_argument("--serve_batch", type=int, default=4,
+                   help="max_batch (resident decode rows) for mode=serve; "
+                        "1 measures the sequential-serving baseline")
+    p.add_argument("--serve_chunk", type=int, default=128,
+                   help="decode segment length for mode=serve")
     p.add_argument("--preset", default="auto", choices=["auto", "7b", "13b", "tiny"])
     # Reference run shape: inference.py:19 max_new_tokens=512.
     p.add_argument("--decode_tokens", type=int, default=512)
@@ -587,6 +645,8 @@ def main() -> None:
         run_warm_probe(args)
     elif args.mode == "spec":
         run_spec(args)
+    elif args.mode == "serve":
+        run_serve(args)
     else:
         run_train(args)
 

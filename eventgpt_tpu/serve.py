@@ -175,6 +175,7 @@ class ContinuousBatcher:
         top_p: float = 1.0,
         eos_token_id: Optional[int] = 2,
         seed: int = 0,
+        kv_quant: bool = False,
     ):
         self.params, self.cfg = params, cfg
         # Admission pads prompts to the serving bucket grain; a max_len off
@@ -189,8 +190,9 @@ class ContinuousBatcher:
         self._dtype = jax.tree_util.tree_leaves(params["llama"])[0].dtype
         if self._dtype not in (jnp.bfloat16, jnp.float32):
             self._dtype = jnp.bfloat16  # quantized tree: compute in bf16
+        self.kv_quant = kv_quant
         self.cache = llama_mod.init_kv_cache(
-            cfg.llama, max_batch, max_len, dtype=self._dtype
+            cfg.llama, max_batch, max_len, dtype=self._dtype, quant=kv_quant
         )
         # Vocab from the actual lm_head leaf, not cfg: special-token
         # registration can grow the embeddings past cfg.llama.vocab_size
@@ -219,9 +221,16 @@ class ContinuousBatcher:
         ids = list(input_ids)
         n_text = sum(1 for t in ids if t != EVENT_TOKEN_INDEX)
         n_ev = sum(1 for t in ids if t == EVENT_TOKEN_INDEX)
+        if n_ev != 1:
+            # splice_embeddings would reject this during _admit, AFTER the
+            # request left the queue — validate here so the loop never
+            # tears down mid-drain.
+            raise ValueError(
+                f"prompt must contain exactly one {EVENT_TOKEN_INDEX} event "
+                f"sentinel, got {n_ev}"
+            )
         prompt_len = min(
-            n_text + n_ev * self.cfg.num_event_tokens,
-            self.cfg.llama.max_seq_len,
+            n_text + self.cfg.num_event_tokens, self.cfg.llama.max_seq_len
         )
         if prompt_len + max_new_tokens + 1 > self.max_len:
             raise ValueError(
@@ -299,7 +308,7 @@ class ContinuousBatcher:
             padded = jnp.pad(padded, ((0, 0), (0, s1 - prompt_len), (0, 0)))
             mask = jnp.pad(mask, ((0, 0), (0, s1 - prompt_len)))
             row_cache = llama_mod.init_kv_cache(
-                self.cfg.llama, 1, s1, dtype=self._dtype
+                self.cfg.llama, 1, s1, dtype=self._dtype, quant=self.kv_quant
             )
             row_logits, row_cache = _prefill_jit(
                 self.params, self.cfg, padded, mask, row_cache, True

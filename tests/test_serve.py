@@ -115,3 +115,26 @@ def test_off_grain_max_len_rounds_up(tiny):
     rid = srv.submit(ids, pv, 5)
     out = srv.run_until_drained()
     assert out[rid] == _oneshot(params, cfg, ids, pv, 5)
+
+
+def test_missing_sentinel_rejected_at_submit(tiny):
+    cfg, params = tiny
+    srv = ContinuousBatcher(params, cfg, max_batch=1, max_len=128)
+    with pytest.raises(ValueError, match="exactly one"):
+        srv.submit([1, 5, 9], _pv(cfg), 4)
+    with pytest.raises(ValueError, match="exactly one"):
+        srv.submit([1, -200, 5, -200], _pv(cfg), 4)
+
+
+def test_kv_quant_server_equals_kv_quant_generate(tiny):
+    cfg, params = tiny
+    ids, pv = [1, 5, -200, 9], _pv(cfg, 4)
+    want = eventchat.generate(
+        params, cfg, [ids], jnp.asarray(pv)[None], max_new_tokens=6,
+        temperature=0.0, eos_token_id=None, kv_quant=True,
+    )[0]
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=3,
+                            eos_token_id=None, kv_quant=True)
+    rid = srv.submit(ids, pv, 6)
+    out = srv.run_until_drained()
+    assert out[rid] == want
