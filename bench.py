@@ -365,10 +365,11 @@ def run_serve(args):
     n_req = args.serve_requests
     srv = ContinuousBatcher(
         params, cfg, max_batch=args.serve_batch,
-        max_len=((35 + cfg.num_event_tokens + 16 + args.decode_tokens + 128)
-                 // 128) * 128,
+        max_len=((35 + cfg.num_event_tokens + 16 + args.decode_tokens
+                  + args.serve_spec + 128) // 128) * 128,
         chunk=args.serve_chunk, eos_token_id=None,
         kv_quant=args.kv == "int8",
+        speculative=args.serve_spec,
     )
     srv.submit(ids, pixels, 8)
     srv.run_until_drained()  # compile warmup (prefill bucket + segment)
@@ -389,6 +390,7 @@ def run_serve(args):
         "chunk": args.serve_chunk,
         "decode_tokens": args.decode_tokens,
         "kv_cache": args.kv,
+        "speculative": args.serve_spec,
         "quant": quant,
         "platform": platform,
     }
@@ -613,6 +615,8 @@ def main() -> None:
                         "1 measures the sequential-serving baseline")
     p.add_argument("--serve_chunk", type=int, default=128,
                    help="decode segment length for mode=serve")
+    p.add_argument("--serve_spec", type=int, default=0,
+                   help="speculative window for mode=serve (0 = plain)")
     p.add_argument("--preset", default="auto", choices=["auto", "7b", "13b", "tiny"])
     # Reference run shape: inference.py:19 max_new_tokens=512.
     p.add_argument("--decode_tokens", type=int, default=512)

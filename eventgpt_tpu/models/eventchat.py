@@ -452,6 +452,89 @@ def _spec_commit_sampled(p, drafts, u, key):
     return a, corrected
 
 
+def _spec_draft_verify(
+    params,
+    cfg: EventChatConfig,
+    ids_buf,
+    pos,             # (B,) next unwritten ids_buf slot per row
+    cache,
+    key,
+    window: int,
+    temperature: float,
+    top_p: float,
+    eos: int,
+):
+    """THE speculative draft-and-verify step, shared by the one-shot loop
+    (``_spec_loop_jit``) and the serving segment
+    (``serve._spec_segment_jit``) so the exact-chain contract cannot drift
+    between them.
+
+    Drafts window-1 tokens by latest-earlier-bigram lookup over
+    ``ids_buf[:, :pos]``, verifies the window in one ``decode_kstep``
+    (greedy argmax at temperature 0, rejection sampling otherwise), and
+    builds the commit window. The cache is returned with ``length``
+    RESTORED to its entry value — the caller advances it by however many
+    tokens it actually commits (budget caps differ between callers).
+
+    Returns (commit (B, W), m_count (B,), first_eos (B,), hit (B,),
+    cache, key): ``commit[:, :m]`` are committable tokens, ``m_count`` the
+    un-capped commit count (accepted + correction), ``first_eos``/``hit``
+    locate an EOS inside the commit prefix.
+    """
+    b, s_ids = ids_buf.shape
+    bidx = jnp.arange(b)
+    iarr = jnp.arange(window)[None, :]
+    sampled = temperature > 0.0
+
+    c0 = ids_buf[bidx, jnp.maximum(pos - 1, 0)]  # newest committed token
+    a_prev = ids_buf[bidx, jnp.maximum(pos - 2, 0)]
+
+    # Latest earlier occurrence of the bigram (a_prev, c0): match ends at j
+    # if ids[j-1]==a_prev and ids[j]==c0, j in [1, pos-2].
+    idx = jnp.arange(s_ids)[None, :]
+    prev = jnp.roll(ids_buf, 1, axis=1)
+    m = (
+        (prev == a_prev[:, None])
+        & (ids_buf == c0[:, None])
+        & (idx >= 1)
+        & (idx <= (pos - 2)[:, None])
+    )
+    j_star = jnp.max(jnp.where(m, idx, -1), axis=1)  # (B,), -1 = none
+    di = j_star[:, None] + jnp.arange(1, window)[None, :]  # (B, W-1)
+    draft_ok = (j_star >= 0)[:, None] & (di <= (pos - 1)[:, None])
+    drafts = jnp.where(
+        draft_ok, ids_buf[bidx[:, None], jnp.clip(di, 0, s_ids - 1)],
+        c0[:, None],
+    )
+
+    wtoks = jnp.concatenate([c0[:, None], drafts], axis=1)  # (B, W)
+    prev_len = cache["length"]
+    embeds = llama_mod.embed_tokens(params["llama"], wtoks)
+    logits, cache = llama_mod.decode_kstep(
+        params["llama"], cfg.llama, embeds, cache
+    )
+    if sampled:
+        key, ku, kc = jax.random.split(key, 3)
+        p = _spec_probs(logits, temperature, top_p)
+        u = jax.random.uniform(ku, (b, window - 1))
+        a, corrected = _spec_commit_sampled(p, drafts, u, kc)
+    else:
+        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, W)
+        # Accepted prefix: drafts[:, :a] all equal their greedy target.
+        acc = jnp.cumprod((drafts == g[:, :-1]).astype(jnp.int32), axis=1)
+        a = acc.sum(axis=1)                       # (B,) in [0, W-1]
+        corrected = g[bidx, a]
+    drafts_p = jnp.concatenate([drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
+    commit = jnp.where(iarr < a[:, None], drafts_p, corrected[:, None])
+    m_count = a + 1
+
+    is_eos = (commit == eos) & (iarr < m_count[:, None])
+    first_eos = jnp.min(jnp.where(is_eos, iarr, window), axis=1)
+    hit = first_eos < window
+    cache = {**cache, "length": prev_len}
+    return commit, m_count, first_eos, hit, cache, key
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "window", "eos_token_id",
@@ -511,7 +594,6 @@ def _spec_loop_jit(
     bidx = jnp.arange(b)
     iarr = jnp.arange(window)[None, :]
     eos = eos_token_id
-    sampled = temperature > 0.0  # static: picks the verification rule
     if key is None:
         key = jax.random.PRNGKey(0)
 
@@ -529,52 +611,12 @@ def _spec_loop_jit(
         ids_buf, n_gen, done, cache, n_iters, key = state
         active = ~done & (n_gen < max_new_tokens)
         pos = prompt_lens + n_gen          # next ids_buf write slot
-        c0 = ids_buf[bidx, pos - 1]        # newest committed, KV not cached
-        a_prev = ids_buf[bidx, jnp.maximum(pos - 2, 0)]
-
-        # Latest earlier occurrence of the bigram (a_prev, c0): match ends
-        # at j if ids[j-1]==a_prev and ids[j]==c0, j in [1, pos-2].
-        idx = jnp.arange(s_ids)[None, :]
-        prev = jnp.roll(ids_buf, 1, axis=1)
-        m = (
-            (prev == a_prev[:, None])
-            & (ids_buf == c0[:, None])
-            & (idx >= 1)
-            & (idx <= (pos - 2)[:, None])
+        commit, m_count, first_eos, hit, cache, key = _spec_draft_verify(
+            params, cfg, ids_buf, pos, cache, key, window,
+            temperature, top_p, eos,
         )
-        j_star = jnp.max(jnp.where(m, idx, -1), axis=1)  # (B,), -1 = none
-        di = j_star[:, None] + jnp.arange(1, window)[None, :]  # (B, W-1)
-        draft_ok = (j_star >= 0)[:, None] & (di <= (pos - 1)[:, None])
-        drafts = jnp.where(
-            draft_ok, ids_buf[bidx[:, None], jnp.clip(di, 0, s_ids - 1)],
-            c0[:, None],
-        )
-
-        wtoks = jnp.concatenate([c0[:, None], drafts], axis=1)  # (B, W)
-        prev_len = cache["length"]
-        embeds = llama_mod.embed_tokens(params["llama"], wtoks)
-        logits, cache = llama_mod.decode_kstep(
-            params["llama"], cfg.llama, embeds, cache
-        )
-        if sampled:
-            key, ku, kc = jax.random.split(key, 3)
-            p = _spec_probs(logits, temperature, top_p)
-            u = jax.random.uniform(ku, (b, window - 1))
-            a, corrected = _spec_commit_sampled(p, drafts, u, kc)
-        else:
-            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, W)
-            # Accepted prefix: drafts[:, :a] all equal their greedy target.
-            acc = jnp.cumprod((drafts == g[:, :-1]).astype(jnp.int32), axis=1)
-            a = acc.sum(axis=1)                       # (B,) in [0, W-1]
-            corrected = g[bidx, a]
-        drafts_p = jnp.concatenate([drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
-        commit = jnp.where(iarr < a[:, None], drafts_p, corrected[:, None])  # (B, W)
-        m_count = a + 1
-
-        # EOS stops the commit window at (and including) the EOS token.
-        is_eos = (commit == eos) & (iarr < m_count[:, None])
-        first_eos = jnp.min(jnp.where(is_eos, iarr, window), axis=1)
-        hit = first_eos < window
+        # EOS stops the commit window at (and including) the EOS token;
+        # this loop allows budget overshoot (clipped at readback).
         m_eff = jnp.where(active, jnp.where(hit, first_eos + 1, m_count), 0)
 
         wpos = jnp.clip(pos[:, None] + iarr, 0, s_ids - 1)
@@ -584,10 +626,10 @@ def _spec_loop_jit(
         )
         n_gen = n_gen + m_eff
         done = done | (active & hit)
-        # Roll back: keep KV only for committed tokens minus the newest
-        # (stale slots above length are masked everywhere and overwritten
-        # by the next window).
-        cache = {**cache, "length": prev_len + m_eff}
+        # Keep KV only for committed tokens minus the newest (stale slots
+        # above length are masked everywhere and overwritten by the next
+        # window).
+        cache = {**cache, "length": cache["length"] + m_eff}
         return ids_buf, n_gen, done, cache, n_iters + 1, key
 
     ids_buf, n_gen, done, cache, n_iters, _ = lax.while_loop(

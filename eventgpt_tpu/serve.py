@@ -120,6 +120,85 @@ def _decode_segment_jit(
     return tokens, n_new, done, logits, cache, key
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "n_iters", "window", "eos_token_id",
+                     "temperature", "top_p"),
+    donate_argnames=("cache",),
+)
+def _spec_segment_jit(
+    params,
+    cfg: EventChatConfig,
+    cache,
+    key,
+    ids_buf,          # (B, S) committed ids; -1 at event/pad positions
+    base_pos,         # (B,) next unwritten ids_buf slot at segment start
+    frozen,           # (B,) bool
+    n_rem,            # (B,) int32 remaining budget per row
+    n_iters: int,
+    window: int,
+    eos_token_id: int,
+    temperature: float = 0.0,
+    top_p: float = 1.0,
+):
+    """``n_iters`` speculative verify iterations over the shared batch —
+    the serving form of ``models/eventchat._spec_loop_jit`` (same bigram
+    drafting, same greedy/rejection-sampled verification) with per-row
+    budgets and a frozen mask, stopping for admission every segment.
+
+    Invariant per active row: ``cache["length"] == base_pos + n_new - 1``
+    (every committed token except the newest has its KV cached; the
+    admission path seeds it by committing the prefill argmax/sample as the
+    first token). Commits are CAPPED at the remaining budget (no
+    overshoot — the row may be harvested right after this segment), and a
+    row is ``done`` only when its EOS lands within that cap.
+
+    Returns (ids_buf, n_new (B,), done (B,), cache, key).
+    """
+    from eventgpt_tpu.models.eventchat import _spec_draft_verify
+
+    b, s_ids = ids_buf.shape
+    bidx = jnp.arange(b)
+    iarr = jnp.arange(window)[None, :]
+    eos = eos_token_id
+
+    def cond(state):
+        it, _, n_new, done, _, _ = state
+        live = ~(frozen | done) & (n_new < n_rem)
+        return (it < n_iters) & live.any()
+
+    def body(state):
+        it, ids_buf, n_new, done, cache, key = state
+        active = ~(frozen | done) & (n_new < n_rem)
+        pos = base_pos + n_new
+        commit, m_count, first_eos, hit, cache, key = _spec_draft_verify(
+            params, cfg, ids_buf, pos, cache, key, window,
+            temperature, top_p, eos,
+        )
+        # Unlike the one-shot loop, commits are CAPPED at the remaining
+        # budget (the row may be harvested right after this segment) and a
+        # row is done only when its EOS lands within the cap.
+        cap = jnp.where(active, n_rem - n_new, 0)
+        m_eff = jnp.minimum(jnp.where(hit, first_eos + 1, m_count), cap)
+
+        wpos = jnp.clip(pos[:, None] + iarr, 0, s_ids - 1)
+        cur = ids_buf[bidx[:, None], wpos]
+        ids_buf = ids_buf.at[bidx[:, None], wpos].set(
+            jnp.where(iarr < m_eff[:, None], commit, cur)
+        )
+        n_new = n_new + m_eff
+        done = done | (active & hit & (first_eos + 1 <= cap))
+        cache = {**cache, "length": cache["length"] + m_eff}
+        return it + 1, ids_buf, n_new, done, cache, key
+
+    _, ids_buf, n_new, done, cache, key = lax.while_loop(
+        cond, body,
+        (jnp.int32(0), ids_buf, jnp.zeros((b,), jnp.int32),
+         jnp.zeros((b,), bool), cache, key),
+    )
+    return ids_buf, n_new, done, cache, key
+
+
 @functools.partial(jax.jit, donate_argnames=("cache", "logits_buf"))
 def _admit_row_jit(cache, logits_buf, row, row_cache, row_logits):
     """Insert a batch-1 prefill result at batch row ``row`` of the shared
@@ -176,6 +255,7 @@ class ContinuousBatcher:
         eos_token_id: Optional[int] = 2,
         seed: int = 0,
         kv_quant: bool = False,
+        speculative: int = 0,
     ):
         self.params, self.cfg = params, cfg
         # Admission pads prompts to the serving bucket grain; a max_len off
@@ -202,6 +282,14 @@ class ContinuousBatcher:
         vocab = (head.get("q", head.get("q4"))
                  if isinstance(head, dict) else head).shape[-1]
         self.logits = jnp.zeros((max_batch, vocab), jnp.float32)
+        # Speculative serving (window > 0): rows draft from their own
+        # committed-token buffer; the prefill argmax/sample is committed at
+        # admission (the _spec_segment_jit invariant) so no logits state
+        # carries between segments.
+        self.speculative = int(speculative)
+        if self.speculative:
+            self.ids_buf = jnp.full((max_batch, self.max_len), -1, jnp.int32)
+            self.base_pos = np.zeros((max_batch,), np.int64)
         self.key = jax.random.PRNGKey(seed)
         self.frozen = np.ones((max_batch,), bool)   # all rows FREE
         self.n_rem = np.zeros((max_batch,), np.int64)
@@ -232,7 +320,9 @@ class ContinuousBatcher:
         prompt_len = min(
             n_text + self.cfg.num_event_tokens, self.cfg.llama.max_seq_len
         )
-        if prompt_len + max_new_tokens + 1 > self.max_len:
+        # Speculative rows write one verify window past their last commit.
+        slack = 1 + self.speculative
+        if prompt_len + max_new_tokens + slack > self.max_len:
             raise ValueError(
                 f"request does not fit: prompt {prompt_len} + budget "
                 f"{max_new_tokens} exceeds server max_len {self.max_len}"
@@ -258,29 +348,51 @@ class ContinuousBatcher:
             return
         frozen = jnp.asarray(self.frozen)
         n_rem = jnp.asarray(self.n_rem.astype(np.int32))
-        tokens, n_new, done, self.logits, self.cache, self.key = (
-            _decode_segment_jit(
-                self.params, self.cfg, self.logits, self.cache, self.key,
-                frozen, n_rem, self.chunk, int(self.eos),
-                self.temperature, self.top_p,
+        if self.speculative:
+            n_iters = max(1, self.chunk // self.speculative)
+            self.ids_buf, n_new, done, self.cache, self.key = (
+                _spec_segment_jit(
+                    self.params, self.cfg, self.cache, self.key,
+                    self.ids_buf, jnp.asarray(self.base_pos.astype(np.int32)),
+                    frozen, n_rem, n_iters, self.speculative, int(self.eos),
+                    self.temperature, self.top_p,
+                )
             )
-        )
-        tokens = np.asarray(jax.device_get(tokens))
+            ids_np = np.asarray(jax.device_get(self.ids_buf))
+            tokens = None
+        else:
+            tokens, n_new, done, self.logits, self.cache, self.key = (
+                _decode_segment_jit(
+                    self.params, self.cfg, self.logits, self.cache, self.key,
+                    frozen, n_rem, self.chunk, int(self.eos),
+                    self.temperature, self.top_p,
+                )
+            )
+            tokens = np.asarray(jax.device_get(tokens))
         n_new = np.asarray(jax.device_get(n_new))
         done = np.asarray(jax.device_get(done))
         for r, req in enumerate(self.rows):
             if req is None or self.frozen[r]:
                 continue
-            req.tokens.extend(int(t) for t in tokens[r, : n_new[r]])
+            if self.speculative:
+                new = ids_np[r, self.base_pos[r]: self.base_pos[r] + n_new[r]]
+                self.base_pos[r] += int(n_new[r])
+            else:
+                new = tokens[r, : n_new[r]]
+            req.tokens.extend(int(t) for t in new)
             self.n_rem[r] -= int(n_new[r])
             if done[r] or self.n_rem[r] <= 0:
-                ids = req.tokens
-                if (self.eos_token_id is not None and ids
-                        and ids[-1] == self.eos_token_id):
-                    ids = ids[:-1]
-                self.finished[req.rid] = ids
-                self.rows[r] = None
-                self.frozen[r] = True
+                self._finish_row(r)
+
+    def _finish_row(self, r: int) -> None:
+        req = self.rows[r]
+        ids = req.tokens
+        if (self.eos_token_id is not None and ids
+                and ids[-1] == self.eos_token_id):
+            ids = ids[:-1]
+        self.finished[req.rid] = ids
+        self.rows[r] = None
+        self.frozen[r] = True
 
     def _admit(self) -> None:
         from eventgpt_tpu.data.tokenizer import split_at_event
@@ -318,5 +430,40 @@ class ContinuousBatcher:
             )
             self.rows[row] = req
             req.row = row
+            if self.speculative:
+                self._admit_speculative(req, row, prompt_len, row_logits)
+                continue
             self.frozen[row] = False
             self.n_rem[row] = req.max_new_tokens
+
+    def _admit_speculative(self, req, row: int, prompt_len: int,
+                           row_logits) -> None:
+        """Speculative-row bookkeeping: reset + write the row's token-id
+        view of the spliced prompt (the bigram-lookup context) and commit
+        the prefill token as the first generated token (the
+        ``_spec_segment_jit`` invariant: cache length == committed - 1)."""
+        from eventgpt_tpu.data.tokenizer import split_at_event
+        from eventgpt_tpu.models.eventchat import _spliced_text_ids
+
+        row_ids = _spliced_text_ids(
+            split_at_event(req.input_ids), self.cfg.num_event_tokens,
+            self.cfg.llama.max_seq_len,
+        )[: self.max_len]
+        # Canonical sampler (argmax at T=0) — the same first-token commit
+        # rule as _spec_loop_jit.
+        self.key, sub = jax.random.split(self.key)
+        t0 = int(sample(row_logits, sub, self.temperature, self.top_p)[0])
+        self.ids_buf = (
+            self.ids_buf.at[row].set(-1)
+            .at[row, : len(row_ids)].set(jnp.asarray(row_ids))
+            .at[row, prompt_len].set(t0)
+        )
+        self.base_pos[row] = prompt_len + 1
+        req.tokens = [t0]
+        self.n_rem[row] = req.max_new_tokens - 1
+        hit_eos = self.eos_token_id is not None and t0 == self.eos_token_id
+        if hit_eos or self.n_rem[row] <= 0:
+            self.frozen[row] = True
+            self._finish_row(row)
+        else:
+            self.frozen[row] = False

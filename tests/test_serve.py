@@ -138,3 +138,49 @@ def test_kv_quant_server_equals_kv_quant_generate(tiny):
     rid = srv.submit(ids, pv, 6)
     out = srv.run_until_drained()
     assert out[rid] == want
+
+
+@pytest.mark.parametrize("window", [2, 4])
+def test_speculative_server_equals_generate(tiny, window):
+    """Speculative continuous batching commits the exact greedy chains."""
+    cfg, params = tiny
+    reqs = [
+        ([1, 5, -200, 9, 9], _pv(cfg, 0), 10),
+        ([1, -200, 7, 7, 8, 14], _pv(cfg, 1), 7),
+        ([3, -200, 11], _pv(cfg, 2), 12),
+    ]
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None, speculative=window)
+    rids = [srv.submit(ids, pv, budget) for ids, pv, budget in reqs]
+    out = srv.run_until_drained()
+    for rid, (ids, pv, budget) in zip(rids, reqs):
+        assert out[rid] == _oneshot(params, cfg, ids, pv, budget), f"req {rid}"
+
+
+def test_speculative_server_eos_and_reuse(tiny):
+    cfg, params = tiny
+    ids, pv = [1, 5, -200, 9, 9], _pv(cfg, 0)
+    full = _oneshot(params, cfg, ids, pv, 12)
+    eos = full[4]
+    want = _oneshot(params, cfg, ids, pv, 12, eos=eos)
+    srv = ContinuousBatcher(params, cfg, max_batch=1, max_len=256, chunk=4,
+                            eos_token_id=eos, speculative=4)
+    a = srv.submit(ids, pv, 12)
+    b = srv.submit(ids, pv, 12)  # queued; reuses the row after a finishes
+    out = srv.run_until_drained()
+    assert out[a] == want and out[b] == want
+    assert len(want) < 12
+
+
+def test_speculative_server_acceptance_on_repetitive_chain(tiny):
+    """Zeros model -> constant chain: the server's drafting collapses
+    iterations just like the one-shot spec loop."""
+    cfg, _ = tiny
+    params = jax.tree_util.tree_map(
+        jnp.zeros_like, eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    )
+    srv = ContinuousBatcher(params, cfg, max_batch=1, max_len=256, chunk=16,
+                            eos_token_id=None, speculative=4)
+    rid = srv.submit([1, 5, -200, 9], _pv(cfg, 0), 16)
+    out = srv.run_until_drained()
+    assert out[rid] == [0] * 16
