@@ -8,6 +8,8 @@ mismatch is replaced by that argmax. The reference has no counterpart
 TPU-native headroom on a weight-bandwidth-bound decode.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -294,3 +296,20 @@ def test_spec_sampled_full_budget_and_eos(tiny):
     )[0]
     assert len(stopped) <= 10
     assert eos not in stopped
+
+
+SAMPLE = "/root/reference/samples/sample1.npy"
+
+
+@pytest.mark.skipif(not os.path.exists(SAMPLE), reason="reference sample absent")
+def test_infer_cli_speculative_equals_greedy():
+    """--speculative through the product CLI returns the plain greedy
+    answer (the flag passthrough, not just the library API)."""
+    from eventgpt_tpu.cli import infer as infer_cli
+
+    common = ["--model_path", "tiny-random", "--event_frame", SAMPLE,
+              "--query", "What?", "--temperature", "0",
+              "--max_new_tokens", "6", "--dtype", "float32"]
+    plain = infer_cli.main(common)
+    spec = infer_cli.main(common + ["--speculative", "4"])
+    assert spec == plain
