@@ -24,9 +24,19 @@ TPU-shaped design (everything jit-visible is static-shape):
     ~2-3% of decode (PERFORMANCE.md: whole-budget vs 64-token budgets).
   * Frozen/free rows keep flowing through the fused step (a ``lax.cond``
     skip would break the donated cache aliasing — same reasoning as
-    ``_decode_loop_jit``); their writes land above their frozen lengths
-    (clamped at the last slot), are masked out of every attention read,
-    and are overwritten when the row is re-admitted.
+    ``_decode_loop_jit``); their writes land above their frozen lengths —
+    kept in bounds by ``submit()``'s slack reservation (prompt + budget +
+    slack <= max_len, so a finished row's write slot never reaches the
+    buffer edge; XLA *drops*, not clamps, out-of-bounds scatter updates,
+    so the slack is the invariant that matters) — are masked out of every
+    attention read, and are overwritten when the row is re-admitted.
+
+Mesh-sharded serving (``mesh=``): the resident cache / logits / ids_buf
+are placed by ``parallel/serving.py``'s layout (batch over ``(data,
+fsdp)``, KV heads and vocab over ``model``) and every scheduler jit gets
+pinned out-shardings so the donated cache keeps aliasing in place —
+the composition of this module with ``parallel/serving.py`` that the
+BASELINE north star (13B continuous batching over a pod) requires.
 
 Greedy equivalence: rows are independent in attention (per-row lengths,
 positions, masks), so a request decoded in a shared batch commits the same
@@ -53,12 +63,7 @@ from eventgpt_tpu.models import eventchat, llama as llama_mod
 from eventgpt_tpu.ops.sampling import sample
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("cfg", "chunk", "eos_token_id", "temperature", "top_p"),
-    donate_argnames=("cache",),
-)
-def _decode_segment_jit(
+def _decode_segment(
     params,
     cfg: EventChatConfig,
     logits,          # (B, V) per-row next-token logits
@@ -99,8 +104,9 @@ def _decode_segment_jit(
         done = done | (commit & (nxt == eos_token_id))
 
         # Unconditional advance preserves donated-cache aliasing through the
-        # while_loop (see _decode_loop_jit). Frozen rows' slot writes clamp
-        # at the last slot and stay masked out of attention reads.
+        # while_loop (see _decode_loop_jit). Frozen rows' slot writes stay
+        # in bounds via submit()'s slack reservation and are masked out of
+        # every attention read.
         emb = llama_mod.embed_tokens(params["llama"], nxt[:, None])
         new_logits, cache = llama_mod.decode_step(
             params["llama"], cfg.llama, emb, cache
@@ -120,13 +126,14 @@ def _decode_segment_jit(
     return tokens, n_new, done, logits, cache, key
 
 
-@functools.partial(
+_decode_segment_jit = functools.partial(
     jax.jit,
-    static_argnames=("cfg", "n_iters", "window", "eos_token_id",
-                     "temperature", "top_p"),
+    static_argnames=("cfg", "chunk", "eos_token_id", "temperature", "top_p"),
     donate_argnames=("cache",),
-)
-def _spec_segment_jit(
+)(_decode_segment)
+
+
+def _spec_segment(
     params,
     cfg: EventChatConfig,
     cache,
@@ -140,6 +147,7 @@ def _spec_segment_jit(
     eos_token_id: int,
     temperature: float = 0.0,
     top_p: float = 1.0,
+    history=None,     # (H,) server-wide served-text lookup buffer
 ):
     """``n_iters`` speculative verify iterations over the shared batch —
     the serving form of ``models/eventchat._spec_loop_jit`` (same bigram
@@ -173,7 +181,7 @@ def _spec_segment_jit(
         pos = base_pos + n_new
         commit, m_count, first_eos, hit, cache, key = _spec_draft_verify(
             params, cfg, ids_buf, pos, cache, key, window,
-            temperature, top_p, eos,
+            temperature, top_p, eos, history=history,
         )
         # Unlike the one-shot loop, commits are CAPPED at the remaining
         # budget (the row may be harvested right after this segment) and a
@@ -199,8 +207,15 @@ def _spec_segment_jit(
     return ids_buf, n_new, done, cache, key
 
 
-@functools.partial(jax.jit, donate_argnames=("cache", "logits_buf"))
-def _admit_row_jit(cache, logits_buf, row, row_cache, row_logits):
+_spec_segment_jit = functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "n_iters", "window", "eos_token_id",
+                     "temperature", "top_p"),
+    donate_argnames=("cache",),
+)(_spec_segment)
+
+
+def _admit_row(cache, logits_buf, row, row_cache, row_logits):
     """Insert a batch-1 prefill result at batch row ``row`` of the shared
     cache (dynamic-update on the batch axis; the prompt bucket length of
     ``row_cache`` is a static shape — one compile per bucket)."""
@@ -221,6 +236,140 @@ def _admit_row_jit(cache, logits_buf, row, row_cache, row_logits):
     return new_cache, logits_buf.at[row].set(row_logits[0])
 
 
+_admit_row_jit = functools.partial(
+    jax.jit, donate_argnames=("cache", "logits_buf")
+)(_admit_row)
+
+
+def _chunk_prefill(params, cfg: EventChatConfig, embeds, cache,
+                   start, new_len, last_idx, chunk: int):
+    """One chunked-admission advance: feed prompt positions
+    [start, start+chunk) of ``embeds`` (1, S1, D) through the speculative
+    verification kernel (``decode_kstep`` — identical attention semantics
+    to one-shot prefill: query i at cache position length+i attends to
+    slots [0, length+i]), then pin the cache length to ``new_len`` (the
+    real prompt prefix filled so far — trailing chunk positions past the
+    prompt are pad, masked from every future read).
+
+    ``start`` must satisfy start+chunk <= S1 (the batcher validates that
+    ``chunk`` divides the bucket grain, so dynamic_slice never clamps —
+    a clamped slice would desynchronize embed positions from the cache
+    write slots). Returns (last_logits (1, V) f32 at window index
+    ``last_idx`` — the prompt's final real token on the finishing chunk,
+    unused otherwise — and the advanced cache).
+    """
+    emb = lax.dynamic_slice(
+        embeds, (0, start, 0), (1, chunk, embeds.shape[-1])
+    )
+    logits, cache = llama_mod.decode_kstep(
+        params["llama"], cfg.llama, emb, cache
+    )
+    last = jnp.take_along_axis(
+        logits, jnp.reshape(last_idx, (1, 1, 1)), axis=1
+    )[:, 0]
+    return last, {**cache, "length": new_len}
+
+
+_chunk_prefill_jit = functools.partial(
+    jax.jit, static_argnames=("cfg", "chunk"), donate_argnames=("cache",)
+)(_chunk_prefill)
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def _gather_new_jit(ids_buf, base_pos, width: int):
+    """Per-row window ``ids_buf[r, base_pos[r] : base_pos[r] + width]`` —
+    the speculative harvest reads back only the slots a segment could have
+    written (width >= n_iters * window) instead of the whole (B, max_len)
+    buffer, so host-transfer cost scales with tokens produced, not cache
+    size."""
+    b, s = ids_buf.shape
+    idx = jnp.clip(
+        base_pos[:, None] + jnp.arange(width)[None, :], 0, s - 1
+    )
+    return ids_buf[jnp.arange(b)[:, None], idx]
+
+
+# -- mesh-sharded scheduler jits ------------------------------------------
+#
+# Same bodies as the single-chip jits above, with OUTPUT SHARDINGS PINNED
+# to the resident buffers' placement. Without the pin, GSPMD may lay the
+# returned cache out differently from the donated input cache, silently
+# breaking buffer aliasing — a second full-size cache allocation per
+# segment (the _get_sharded_prefill reasoning, models/eventchat.py).
+# Keyed per (config, statics, shardings): one compile per serving setup.
+
+
+@functools.lru_cache(maxsize=16)
+def _get_sharded_decode_segment(
+    cfg, chunk, eos_token_id, temperature, top_p,
+    flat_cache_sh, cache_treedef, logits_sh, toks_sh, b_sh, key_sh,
+):
+    cache_sh = jax.tree_util.tree_unflatten(cache_treedef, list(flat_cache_sh))
+    return jax.jit(
+        lambda params, logits, cache, key, frozen, n_rem: _decode_segment(
+            params, cfg, logits, cache, key, frozen, n_rem,
+            chunk, eos_token_id, temperature, top_p,
+        ),
+        donate_argnums=(2,),
+        out_shardings=(toks_sh, b_sh, b_sh, logits_sh, cache_sh, key_sh),
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _get_sharded_spec_segment(
+    cfg, n_iters, window, eos_token_id, temperature, top_p,
+    flat_cache_sh, cache_treedef, ids_sh, b_sh, key_sh,
+):
+    cache_sh = jax.tree_util.tree_unflatten(cache_treedef, list(flat_cache_sh))
+    return jax.jit(
+        lambda params, cache, key, ids_buf, base_pos, frozen, n_rem, history:
+        _spec_segment(
+            params, cfg, cache, key, ids_buf, base_pos, frozen, n_rem,
+            n_iters, window, eos_token_id, temperature, top_p,
+            history=history,
+        ),
+        donate_argnums=(1,),
+        out_shardings=(ids_sh, b_sh, b_sh, cache_sh, key_sh),
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _get_sharded_admit(flat_cache_sh, cache_treedef, logits_sh):
+    cache_sh = jax.tree_util.tree_unflatten(cache_treedef, list(flat_cache_sh))
+    return jax.jit(
+        _admit_row,
+        donate_argnums=(0, 1),
+        out_shardings=(cache_sh, logits_sh),
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _get_sharded_chunk_prefill(cfg, chunk, flat_row_sh, row_treedef, last_sh):
+    row_sh = jax.tree_util.tree_unflatten(row_treedef, list(flat_row_sh))
+    return jax.jit(
+        lambda params, embeds, cache, start, new_len, last_idx:
+        _chunk_prefill(
+            params, cfg, embeds, cache, start, new_len, last_idx, chunk
+        ),
+        donate_argnums=(2,),
+        out_shardings=(last_sh, row_sh),
+    )
+
+
+@dataclass
+class _PendingAdmission:
+    """A chunked admission in flight: the row is reserved (frozen), the
+    prompt prefix [0, filled) is prefilled into ``row_cache``, and one
+    chunk advances per scheduler step so active rows keep decoding."""
+    req: "_Request"
+    row: int
+    embeds: Any          # (1, S1, D) padded prompt embeddings
+    prompt_len: int
+    row_cache: Any
+    filled: int = 0
+    last_logits: Any = None
+
+
 @dataclass
 class _Request:
     rid: int
@@ -229,6 +378,12 @@ class _Request:
     max_new_tokens: int
     tokens: List[int] = field(default_factory=list)
     row: int = -1
+    # Service timestamps (time.perf_counter at submit / first committed
+    # token / completion) — the continuous-batching latency story: TTFT
+    # and completion latency per request, aggregated by bench --mode serve.
+    t_submit: float = 0.0
+    t_first: Optional[float] = None
+    t_done: Optional[float] = None
 
 
 class ContinuousBatcher:
@@ -239,8 +394,13 @@ class ContinuousBatcher:
     >>> answers = srv.run_until_drained()   # {rid: [token ids]}
 
     Greedy by default (temperature 0); sampling configs apply serverwide.
-    Single-chip for now — the serving-mesh path (parallel/serving.py)
-    composes with one-shot ``generate``.
+
+    ``mesh``: a serving ``Mesh`` (data/fsdp/model, context=1). ``params``
+    must already be placed by ``parallel.serving.shard_params_for_serving``;
+    the batcher places its resident cache / logits / ids_buf to match and
+    pins every scheduler jit's out-shardings (BASELINE config 5: 13B
+    continuous batching needs the serving mesh AND row-level admission at
+    once — vs the reference's single-GPU one-shot ``inference.py:52-63``).
     """
 
     def __init__(
@@ -256,7 +416,35 @@ class ContinuousBatcher:
         seed: int = 0,
         kv_quant: bool = False,
         speculative: int = 0,
+        mesh=None,
+        prefill_chunk: int = 0,
+        history_len: int = 2048,
     ):
+        if prefill_chunk and (2 * SEQ_BUCKET) % prefill_chunk:
+            # A chunk that does not divide the bucket grain would force
+            # dynamic_slice to clamp the final chunk's start, desyncing
+            # embed positions from cache write slots (_chunk_prefill).
+            raise ValueError(
+                f"prefill_chunk must divide the prompt bucket grain "
+                f"{2 * SEQ_BUCKET}, got {prefill_chunk}"
+            )
+        if mesh is not None:
+            import dataclasses
+
+            from eventgpt_tpu.parallel import serving as serving_mod
+
+            serving_mod._require_serving_mesh(mesh)
+            model_n = mesh.shape.get("model", 1)
+            if (cfg.llama.attn_impl == "flash"
+                    and cfg.llama.num_heads % model_n != 0):
+                # Same downgrade as generate(): flash under a mesh runs
+                # per-shard with heads over model; dense scores are the
+                # safe prefill fallback when heads don't divide.
+                cfg = dataclasses.replace(
+                    cfg,
+                    llama=dataclasses.replace(cfg.llama, attn_impl="dense"),
+                )
+        self.mesh = mesh
         self.params, self.cfg = params, cfg
         # Admission pads prompts to the serving bucket grain; a max_len off
         # the grain would let a bucketed row_cache outgrow the shared cache
@@ -276,11 +464,8 @@ class ContinuousBatcher:
         )
         # Vocab from the actual lm_head leaf, not cfg: special-token
         # registration can grow the embeddings past cfg.llama.vocab_size
-        # (prepare_model's resize). int4 leaves pack K/2 on the
-        # second-to-last dim; the vocab (last) dim is unpacked either way.
-        head = params["llama"]["lm_head"]
-        vocab = (head.get("q", head.get("q4"))
-                 if isinstance(head, dict) else head).shape[-1]
+        # (prepare_model's resize).
+        vocab = eventchat._vocab_size(params)
         self.logits = jnp.zeros((max_batch, vocab), jnp.float32)
         # Speculative serving (window > 0): rows draft from their own
         # committed-token buffer; the prefill argmax/sample is committed at
@@ -290,15 +475,168 @@ class ContinuousBatcher:
         if self.speculative:
             self.ids_buf = jnp.full((max_batch, self.max_len), -1, jnp.int32)
             self.base_pos = np.zeros((max_batch,), np.int64)
+        # Server-wide served-text history: a chronological buffer of prompt
+        # text + committed answers across ALL requests, used as extra
+        # lookup context by the speculative draft (_suffix_vote_drafts) —
+        # cross-request echo ("The scene depicts...") is draftable even on
+        # a request's first turn. 0 disables.
+        self._history = (
+            np.full((int(history_len),), -1, np.int64)
+            if self.speculative and history_len else None
+        )
         self.key = jax.random.PRNGKey(seed)
+        if mesh is not None:
+            self._init_mesh_placement(vocab)
         self.frozen = np.ones((max_batch,), bool)   # all rows FREE
         self.n_rem = np.zeros((max_batch,), np.int64)
         self.rows: List[Optional[_Request]] = [None] * max_batch
         self.queue: deque[_Request] = deque()
         self.finished: Dict[int, List[int]] = {}
         self._next_rid = 0
+        self.prefill_chunk = int(prefill_chunk)
+        self._pending: Optional[_PendingAdmission] = None
+        # Service metrics: wall time spent inside _admit (the stall decode
+        # rows experience per scheduling iteration) and per-request
+        # TTFT / completion latency, keyed by rid.
+        self.admission_s = 0.0
+        self.request_stats: Dict[int, Dict[str, float]] = {}
+
+    def _init_mesh_placement(self, vocab: int) -> None:
+        """Place the resident buffers on the serving mesh and record their
+        shardings (the out-sharding pins for every scheduler jit)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from eventgpt_tpu.parallel import serving as serving_mod
+
+        mesh = self.mesh
+        self._serving = serving_mod
+        self.cache = serving_mod.shard_kv_cache(self.cache, self.cfg.llama, mesh)
+        baxes = serving_mod.serving_batch_axes(mesh, self.max_batch)
+        bspec = baxes if baxes else None
+        model_n = mesh.shape.get("model", 1)
+        vocab_ax = "model" if (model_n > 1 and vocab % model_n == 0) else None
+        self._logits_sh = NamedSharding(mesh, P(bspec, vocab_ax))
+        # Batch-1 admission logits (chunked prefill's last-token output).
+        self._row_logits_sh = NamedSharding(mesh, P(None, vocab_ax))
+        self.logits = jax.device_put(self.logits, self._logits_sh)
+        self._b_sh = NamedSharding(mesh, P(bspec))
+        self._toks_sh = NamedSharding(mesh, P(bspec, None))
+        self._key_sh = NamedSharding(mesh, P())
+        self.key = jax.device_put(self.key, self._key_sh)
+        if self.speculative:
+            self._ids_sh = NamedSharding(mesh, P(bspec, None))
+            self.ids_buf = jax.device_put(self.ids_buf, self._ids_sh)
+        cache_sh = jax.tree_util.tree_map(lambda x: x.sharding, self.cache)
+        flat, treedef = jax.tree_util.tree_flatten(cache_sh)
+        self._cache_flat_sh, self._cache_treedef = tuple(flat), treedef
 
     # -- client surface ---------------------------------------------------
+
+    def warmup(self, prompt_lens: Optional[Sequence[int]] = None) -> int:
+        """Precompile every executable a request could hit — the vision
+        encoder, one prefill per prompt bucket (+ the chunked-prefill
+        kernel when enabled), row admission, and the decode/spec segment —
+        so no request pays XLA compile (or persistent-cache executable
+        load) mid-service. ``prompt_lens``: expected prompt lengths (text +
+        event tokens); default warms every bucket up to max_len/context.
+
+        Runs the REAL jit callables against the live resident state: a
+        zeros batch-1 prefill admitted into row 0 is dead storage (the row
+        stays FREE/frozen; its cache slots and logits are overwritten at
+        the next real admission), and a segment with every row frozen
+        exits its while_loop at entry — a no-op dispatch that still
+        compiles and caches the executable. Returns the number of warmed
+        callables.
+        """
+        from eventgpt_tpu.models.eventchat import _prefill_jit, _prefill_sharded
+
+        grain = 2 * SEQ_BUCKET
+        if prompt_lens is None:
+            limit = min(
+                self.max_len,
+                ((self.cfg.llama.max_seq_len + grain - 1) // grain) * grain,
+            )
+            buckets = list(range(grain, limit + 1, grain))
+        else:
+            buckets = sorted({
+                min(((max(int(p), 1) + grain - 1) // grain) * grain,
+                    self.max_len)
+                for p in prompt_lens
+            })
+        n = 0
+        pv = jnp.zeros(
+            (1, self.cfg.num_event_frames, 3, self.cfg.vision.image_size,
+             self.cfg.vision.image_size), self._dtype,
+        )
+        if self.mesh is not None:
+            pv = self._serving.shard_batch_array(pv, self.mesh)
+        jax.block_until_ready(
+            eventchat.encode_events_batch(self.params, self.cfg, pv)
+        )
+        n += 1
+        d = self.cfg.llama.hidden_size
+        for s1 in buckets:
+            padded = jnp.zeros((1, s1, d), self._dtype)
+            mask = jnp.ones((1, s1), bool)
+            row_cache = self._new_row_cache(s1)
+            if self.mesh is not None:
+                padded = self._serving.shard_batch_array(padded, self.mesh)
+                mask = self._serving.shard_batch_array(mask, self.mesh)
+                row_logits, row_cache = _prefill_sharded(
+                    self.params, self.cfg, padded, mask, row_cache, self.mesh
+                )
+            else:
+                row_logits, row_cache = _prefill_jit(
+                    self.params, self.cfg, padded, mask, row_cache, True
+                )
+            n += 1
+            if self.prefill_chunk:
+                # One chunk at this bucket's embed shape compiles the
+                # chunked-admission executable (its dummy cache is dropped).
+                chunk_cache = self._new_row_cache(s1)
+                start_arr = jnp.asarray(0, jnp.int32)
+                new_len = jnp.asarray([1], jnp.int32)
+                last_idx = jnp.asarray(0, jnp.int32)
+                if self.mesh is not None:
+                    row_sh = jax.tree_util.tree_map(
+                        lambda x: x.sharding, chunk_cache
+                    )
+                    flat, treedef = jax.tree_util.tree_flatten(row_sh)
+                    fn = _get_sharded_chunk_prefill(
+                        self.cfg, self.prefill_chunk, tuple(flat),
+                        treedef, self._row_logits_sh,
+                    )
+                    fn(self.params, padded, chunk_cache, start_arr,
+                       new_len, last_idx)
+                else:
+                    _chunk_prefill_jit(
+                        self.params, self.cfg, padded, chunk_cache,
+                        start_arr, new_len, last_idx, self.prefill_chunk,
+                    )
+                n += 1
+            # Admission executable (keyed per bucket): write into row 0 —
+            # dead storage for a FREE row, overwritten at real admission.
+            if self.mesh is not None:
+                admit = _get_sharded_admit(
+                    self._cache_flat_sh, self._cache_treedef, self._logits_sh
+                )
+            else:
+                admit = _admit_row_jit
+            self.cache, self.logits = admit(
+                self.cache, self.logits, 0, row_cache, row_logits
+            )
+            n += 1
+        # Zero the dummy row length so its pre-admission frozen-row write
+        # slot stays far from the buffer edge (hygiene; writes above the
+        # length are masked/dropped either way).
+        self.cache = {**self.cache, "length": self.cache["length"] * 0}
+        # Segment executable: all rows frozen -> no-op dispatch.
+        self._segment(
+            jnp.asarray(np.ones((self.max_batch,), bool)),
+            jnp.zeros((self.max_batch,), jnp.int32),
+        )
+        n += 1
+        return n
 
     def submit(self, input_ids: Sequence[int], pixel_values,
                max_new_tokens: int = 64) -> int:
@@ -327,9 +665,13 @@ class ContinuousBatcher:
                 f"request does not fit: prompt {prompt_len} + budget "
                 f"{max_new_tokens} exceeds server max_len {self.max_len}"
             )
+        import time
+
         rid = self._next_rid
         self._next_rid += 1
-        self.queue.append(_Request(rid, ids, pixel_values, max_new_tokens))
+        req = _Request(rid, ids, pixel_values, max_new_tokens)
+        req.t_submit = time.perf_counter()
+        self.queue.append(req)
         return rid
 
     def run_until_drained(self) -> Dict[int, List[int]]:
@@ -341,100 +683,277 @@ class ContinuousBatcher:
     # -- scheduler core ---------------------------------------------------
 
     def step(self) -> None:
-        """One scheduling iteration: admit into free rows, run one decode
+        """One scheduling iteration: admit into free rows (one prefill
+        chunk when a chunked admission is in flight), run one decode
         segment, harvest finished rows."""
+        import time
+
+        t0 = time.perf_counter()
         self._admit()
+        self.admission_s += time.perf_counter() - t0
         if all(r is None for r in self.rows):
             return
-        frozen = jnp.asarray(self.frozen)
-        n_rem = jnp.asarray(self.n_rem.astype(np.int32))
-        if self.speculative:
-            n_iters = max(1, self.chunk // self.speculative)
-            self.ids_buf, n_new, done, self.cache, self.key = (
-                _spec_segment_jit(
-                    self.params, self.cfg, self.cache, self.key,
-                    self.ids_buf, jnp.asarray(self.base_pos.astype(np.int32)),
-                    frozen, n_rem, n_iters, self.speculative, int(self.eos),
-                    self.temperature, self.top_p,
-                )
-            )
-            ids_np = np.asarray(jax.device_get(self.ids_buf))
-            tokens = None
-        else:
-            tokens, n_new, done, self.logits, self.cache, self.key = (
-                _decode_segment_jit(
-                    self.params, self.cfg, self.logits, self.cache, self.key,
-                    frozen, n_rem, self.chunk, int(self.eos),
-                    self.temperature, self.top_p,
-                )
-            )
-            tokens = np.asarray(jax.device_get(tokens))
-        n_new = np.asarray(jax.device_get(n_new))
-        done = np.asarray(jax.device_get(done))
+        if bool(self.frozen.all()):
+            # Only reserved (pending-admission) rows exist — nothing to
+            # decode yet; the pending prefill advanced above.
+            return
+        tokens, new_np, n_new, done = self._segment(
+            jnp.asarray(self.frozen), jnp.asarray(self.n_rem.astype(np.int32))
+        )
+        now = time.perf_counter()
         for r, req in enumerate(self.rows):
             if req is None or self.frozen[r]:
                 continue
             if self.speculative:
-                new = ids_np[r, self.base_pos[r]: self.base_pos[r] + n_new[r]]
+                new = new_np[r, : n_new[r]]
                 self.base_pos[r] += int(n_new[r])
             else:
                 new = tokens[r, : n_new[r]]
+            if len(new) and req.t_first is None:
+                req.t_first = now
             req.tokens.extend(int(t) for t in new)
             self.n_rem[r] -= int(n_new[r])
             if done[r] or self.n_rem[r] <= 0:
                 self._finish_row(r)
 
+    def _segment(self, frozen, n_rem):
+        """Dispatch one decode/spec segment on the resident state. Returns
+        ``(tokens, new_np, n_new, done)`` as host arrays (``tokens`` for
+        the plain path, ``new_np`` the per-row committed window for the
+        speculative path). Also the warmup entry point: with every row
+        frozen the while_loop exits at entry — a no-op dispatch that still
+        compiles and caches the segment executable."""
+        if self.speculative:
+            n_iters = max(1, self.chunk // self.speculative)
+            base_pos = jnp.asarray(self.base_pos.astype(np.int32))
+            history = (jnp.asarray(self._history.astype(np.int32))
+                       if self._history is not None else None)
+            if self.mesh is not None:
+                if history is not None:
+                    history = self._serving.replicate(history, self.mesh)
+                fn = _get_sharded_spec_segment(
+                    self.cfg, n_iters, self.speculative, int(self.eos),
+                    self.temperature, self.top_p,
+                    self._cache_flat_sh, self._cache_treedef,
+                    self._ids_sh, self._b_sh, self._key_sh,
+                )
+                self.ids_buf, n_new, done, self.cache, self.key = fn(
+                    self.params, self.cache, self.key, self.ids_buf,
+                    base_pos, frozen, n_rem, history,
+                )
+            else:
+                self.ids_buf, n_new, done, self.cache, self.key = (
+                    _spec_segment_jit(
+                        self.params, self.cfg, self.cache, self.key,
+                        self.ids_buf, base_pos,
+                        frozen, n_rem, n_iters, self.speculative,
+                        int(self.eos), self.temperature, self.top_p,
+                        history=history,
+                    )
+                )
+            # Read back only the window a segment could have written
+            # (n_iters * window <= max(chunk, window) slots per row), not
+            # the whole (B, max_len) buffer.
+            width = max(self.chunk, self.speculative)
+            new_np = np.asarray(jax.device_get(
+                _gather_new_jit(self.ids_buf, base_pos, width)
+            ))
+            tokens = None
+        else:
+            if self.mesh is not None:
+                fn = _get_sharded_decode_segment(
+                    self.cfg, self.chunk, int(self.eos),
+                    self.temperature, self.top_p,
+                    self._cache_flat_sh, self._cache_treedef,
+                    self._logits_sh, self._toks_sh, self._b_sh, self._key_sh,
+                )
+                tokens, n_new, done, self.logits, self.cache, self.key = fn(
+                    self.params, self.logits, self.cache, self.key,
+                    frozen, n_rem,
+                )
+            else:
+                tokens, n_new, done, self.logits, self.cache, self.key = (
+                    _decode_segment_jit(
+                        self.params, self.cfg, self.logits, self.cache,
+                        self.key, frozen, n_rem, self.chunk, int(self.eos),
+                        self.temperature, self.top_p,
+                    )
+                )
+            tokens = np.asarray(jax.device_get(tokens))
+            new_np = None
+        n_new = np.asarray(jax.device_get(n_new))
+        done = np.asarray(jax.device_get(done))
+        return tokens, new_np, n_new, done
+
     def _finish_row(self, r: int) -> None:
+        import time
+
         req = self.rows[r]
         ids = req.tokens
         if (self.eos_token_id is not None and ids
                 and ids[-1] == self.eos_token_id):
             ids = ids[:-1]
+        req.t_done = time.perf_counter()
+        # Bounded: a long-lived server must not grow host state per
+        # request forever (oldest-first eviction; dicts are
+        # insertion-ordered).
+        while len(self.request_stats) >= 8192:
+            self.request_stats.pop(next(iter(self.request_stats)))
+        self.request_stats[req.rid] = {
+            "ttft_s": (req.t_first if req.t_first is not None
+                       else req.t_done) - req.t_submit,
+            "latency_s": req.t_done - req.t_submit,
+        }
+        self._history_append(ids)
         self.finished[req.rid] = ids
         self.rows[r] = None
         self.frozen[r] = True
 
-    def _admit(self) -> None:
-        from eventgpt_tpu.data.tokenizer import split_at_event
-        from eventgpt_tpu.models.eventchat import (
-            _pad_batch, _prefill_jit, splice_embeddings,
-        )
+    def _history_append(self, toks) -> None:
+        """Append committed/prompt text to the chronological history ring
+        (oldest tokens shift out; -1 fillers are dropped at the source so
+        they never waste lookup slots)."""
+        if self._history is None:
+            return
+        arr = np.asarray([t for t in toks if t >= 0], np.int64)
+        if not len(arr):
+            return
+        h = len(self._history)
+        if len(arr) >= h:
+            self._history[:] = arr[-h:]
+        else:
+            self._history[:-len(arr)] = self._history[len(arr):]
+            self._history[-len(arr):] = arr
 
-        while self.queue and any(self.rows[r] is None
-                                 for r in range(self.max_batch)):
+    def _admit(self) -> None:
+        from eventgpt_tpu.models.eventchat import _prefill_jit, _prefill_sharded
+
+        if self._pending is not None:
+            self._advance_pending()
+        while (self._pending is None and self.queue
+               and any(self.rows[r] is None
+                       for r in range(self.max_batch))):
             req = self.queue.popleft()
             row = next(r for r in range(self.max_batch)
                        if self.rows[r] is None)
-            pv = jnp.asarray(req.pixel_values, self._dtype)
-            ev = eventchat.encode_events_batch(self.params, self.cfg, pv[None])
-            embeds = [splice_embeddings(
-                self.params, self.cfg, split_at_event(req.input_ids), ev[0]
-            )]
-            padded, mask, lens = _pad_batch(embeds)
-            prompt_len = int(lens[0])
-            bucket = 2 * SEQ_BUCKET
-            # submit() validated the fit and max_len is grain-aligned, so
-            # the bucketed prompt can never outgrow the shared cache.
-            s1 = min(((prompt_len + bucket - 1) // bucket) * bucket,
-                     self.max_len)
-            padded = jnp.pad(padded, ((0, 0), (0, s1 - prompt_len), (0, 0)))
-            mask = jnp.pad(mask, ((0, 0), (0, s1 - prompt_len)))
-            row_cache = llama_mod.init_kv_cache(
-                self.cfg.llama, 1, s1, dtype=self._dtype, quant=self.kv_quant
+            padded, mask, prompt_len = self._prep_request(req)
+            row_cache = self._new_row_cache(padded.shape[1])
+            if self.prefill_chunk and not bool(self.frozen.all()):
+                # Active rows are decoding: chunked admission. Reserve the
+                # row (kept frozen) and advance ONE prefill chunk per
+                # scheduler step, so a long prompt stalls each decode
+                # segment by at most one chunk instead of its full prefill.
+                self.rows[row] = req
+                req.row = row
+                self._pending = _PendingAdmission(
+                    req, row, padded, prompt_len, row_cache
+                )
+                self._advance_pending()
+                break
+            # No active rows to stall (or chunking disabled): one-shot
+            # prefill at the bucket length.
+            if self.mesh is not None:
+                row_logits, row_cache = _prefill_sharded(
+                    self.params, self.cfg, padded, mask, row_cache, self.mesh
+                )
+            else:
+                row_logits, row_cache = _prefill_jit(
+                    self.params, self.cfg, padded, mask, row_cache, True
+                )
+            self._finish_admission(req, row, prompt_len, row_cache, row_logits)
+
+    def _prep_request(self, req: _Request):
+        """Host + encode prep for one admission: CLIP encode, splice, pad
+        to the prompt bucket. Returns (padded (1, S1, D), mask, prompt_len).
+        submit() validated the fit and max_len is grain-aligned, so the
+        bucketed prompt can never outgrow the shared cache."""
+        from eventgpt_tpu.data.tokenizer import split_at_event
+        from eventgpt_tpu.models.eventchat import _pad_batch, splice_embeddings
+
+        pv = jnp.asarray(req.pixel_values, self._dtype)[None]
+        if self.mesh is not None:
+            pv = self._serving.shard_batch_array(pv, self.mesh)
+        ev = eventchat.encode_events_batch(self.params, self.cfg, pv)
+        embeds = [splice_embeddings(
+            self.params, self.cfg, split_at_event(req.input_ids), ev[0]
+        )]
+        padded, mask, lens = _pad_batch(embeds)
+        prompt_len = int(lens[0])
+        bucket = 2 * SEQ_BUCKET
+        s1 = min(((prompt_len + bucket - 1) // bucket) * bucket, self.max_len)
+        padded = jnp.pad(padded, ((0, 0), (0, s1 - prompt_len), (0, 0)))
+        mask = jnp.pad(mask, ((0, 0), (0, s1 - prompt_len)))
+        if self.mesh is not None:
+            padded = self._serving.shard_batch_array(padded, self.mesh)
+            mask = self._serving.shard_batch_array(mask, self.mesh)
+        return padded, mask, prompt_len
+
+    def _new_row_cache(self, s1: int):
+        row_cache = llama_mod.init_kv_cache(
+            self.cfg.llama, 1, s1, dtype=self._dtype, quant=self.kv_quant
+        )
+        if self.mesh is not None:
+            row_cache = self._serving.shard_kv_cache(
+                row_cache, self.cfg.llama, self.mesh
             )
-            row_logits, row_cache = _prefill_jit(
-                self.params, self.cfg, padded, mask, row_cache, True
+        return row_cache
+
+    def _advance_pending(self) -> None:
+        """Run one prefill chunk of the in-flight admission; on the final
+        chunk, insert the row into the shared cache and activate it."""
+        p = self._pending
+        c = self.prefill_chunk
+        start = p.filled
+        end = min(start + c, p.prompt_len)
+        start_arr = jnp.asarray(start, jnp.int32)
+        new_len = jnp.asarray([end], jnp.int32)
+        last_idx = jnp.asarray(
+            max(0, min(p.prompt_len - 1 - start, c - 1)), jnp.int32
+        )
+        if self.mesh is not None:
+            row_sh = jax.tree_util.tree_map(
+                lambda x: x.sharding, p.row_cache
             )
-            self.cache, self.logits = _admit_row_jit(
-                self.cache, self.logits, row, row_cache, row_logits
+            flat, treedef = jax.tree_util.tree_flatten(row_sh)
+            fn = _get_sharded_chunk_prefill(
+                self.cfg, c, tuple(flat), treedef, self._row_logits_sh
             )
-            self.rows[row] = req
-            req.row = row
-            if self.speculative:
-                self._admit_speculative(req, row, prompt_len, row_logits)
-                continue
-            self.frozen[row] = False
-            self.n_rem[row] = req.max_new_tokens
+            last, p.row_cache = fn(
+                self.params, p.embeds, p.row_cache, start_arr, new_len,
+                last_idx,
+            )
+        else:
+            last, p.row_cache = _chunk_prefill_jit(
+                self.params, self.cfg, p.embeds, p.row_cache,
+                start_arr, new_len, last_idx, c,
+            )
+        p.filled = end
+        p.last_logits = last
+        if p.filled >= p.prompt_len:
+            self._finish_admission(
+                p.req, p.row, p.prompt_len, p.row_cache, last
+            )
+            self._pending = None
+
+    def _finish_admission(self, req, row, prompt_len, row_cache,
+                          row_logits) -> None:
+        """Insert the prefilled row into the shared cache + activate it."""
+        if self.mesh is not None:
+            admit = _get_sharded_admit(
+                self._cache_flat_sh, self._cache_treedef, self._logits_sh
+            )
+        else:
+            admit = _admit_row_jit
+        self.cache, self.logits = admit(
+            self.cache, self.logits, row, row_cache, row_logits
+        )
+        self.rows[row] = req
+        req.row = row
+        if self.speculative:
+            self._admit_speculative(req, row, prompt_len, row_logits)
+            return
+        self.frozen[row] = False
+        self.n_rem[row] = req.max_new_tokens
 
     def _admit_speculative(self, req, row: int, prompt_len: int,
                            row_logits) -> None:
@@ -445,19 +964,34 @@ class ContinuousBatcher:
         from eventgpt_tpu.data.tokenizer import split_at_event
         from eventgpt_tpu.models.eventchat import _spliced_text_ids
 
+        if req.max_new_tokens == 0:
+            # Parity with one-shot generate (and the plain server): a zero
+            # budget returns zero tokens — skip the prefill-token commit
+            # that seeds the speculative invariant.
+            req.tokens = []
+            self._finish_row(row)
+            return
         row_ids = _spliced_text_ids(
             split_at_event(req.input_ids), self.cfg.num_event_tokens,
             self.cfg.llama.max_seq_len,
         )[: self.max_len]
+        self._history_append(row_ids)  # prompt text joins the lookup pool
         # Canonical sampler (argmax at T=0) — the same first-token commit
         # rule as _spec_loop_jit.
+        import time
+
         self.key, sub = jax.random.split(self.key)
         t0 = int(sample(row_logits, sub, self.temperature, self.top_p)[0])
+        req.t_first = time.perf_counter()
         self.ids_buf = (
             self.ids_buf.at[row].set(-1)
             .at[row, : len(row_ids)].set(jnp.asarray(row_ids))
             .at[row, prompt_len].set(t0)
         )
+        if self.mesh is not None:
+            # Scatter chains can drop the batch sharding; re-pin so the next
+            # spec segment's pinned input/output shardings stay aliasing.
+            self.ids_buf = jax.device_put(self.ids_buf, self._ids_sh)
         self.base_pos[row] = prompt_len + 1
         req.tokens = [t0]
         self.n_rem[row] = req.max_new_tokens - 1

@@ -66,9 +66,14 @@ def capture(args) -> str:
         return _prefill_jit(params, cfg, padded, mask, cache, True)
 
     key = jax.random.PRNGKey(0)
-    loop = lambda lg, cch: _decode_loop_jit(
-        params, cfg, lg, cch, key, args.decode_tokens, 0.0, 1.0, -1
-    )
+
+    def loop(lg, cch):
+        toks, n, cch = _decode_loop_jit(
+            params, cfg, lg, cch, key, args.decode_tokens, 0.0, 1.0, -1
+        )
+        del cch  # returned only for donation aliasing
+        return toks, n
+
     last, cache = prefill_once()
     _sync(last)
     toks, _ = loop(last, cache)  # compile

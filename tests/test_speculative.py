@@ -139,8 +139,55 @@ def test_spec_kv_quant_equals_plain_kv_quant(tiny):
     assert spec == plain
 
 
+def test_suffix_vote_drafts_majority_beats_latest():
+    """The r4 draft rule votes among all occurrences at the deepest match
+    level: with continuations {3, 3, 4} after the (1, 9) suffix, the draft
+    is 3 (majority) — the r3 latest-match rule would have picked 4."""
+    from eventgpt_tpu.models.eventchat import _suffix_vote_drafts
+
+    params = {"llama": {"lm_head": jnp.zeros((8, 50))}}
+    row = [1, 9, 3, 1, 9, 3, 1, 9, 4, 1, 9]
+    ids = np.full((1, 32), -1, np.int32)
+    ids[0, : len(row)] = row
+    drafts = _suffix_vote_drafts(
+        params, jnp.asarray(ids), jnp.asarray([len(row)], jnp.int32),
+        window=2,
+    )
+    assert drafts.shape == (1, 1)
+    assert int(drafts[0, 0]) == 3
+
+
+def test_suffix_vote_drafts_requery_follows_history():
+    """Drafted tokens extend the suffix, so a deep match in the server
+    history buffer is followed token-by-token across the whole window."""
+    from eventgpt_tpu.models.eventchat import _suffix_vote_drafts
+
+    params = {"llama": {"lm_head": jnp.zeros((8, 50))}}
+    ids = np.full((1, 16), -1, np.int32)
+    ids[0, :2] = [7, 8]          # committed text ends ... 7, 8
+    hist = np.full((24,), -1, np.int32)
+    hist[:6] = [1, 7, 8, 9, 10, 11]   # 7,8 seen before, followed by 9,10,11
+    drafts = _suffix_vote_drafts(
+        params, jnp.asarray(ids), jnp.asarray([2], jnp.int32),
+        window=4, history=jnp.asarray(hist),
+    )
+    assert [int(t) for t in drafts[0]] == [9, 10, 11]
+
+
+def test_suffix_vote_drafts_no_match_repeats_newest():
+    from eventgpt_tpu.models.eventchat import _suffix_vote_drafts
+
+    params = {"llama": {"lm_head": jnp.zeros((8, 50))}}
+    ids = np.full((1, 16), -1, np.int32)
+    ids[0, :3] = [3, 4, 5]       # all distinct: no earlier suffix match
+    drafts = _suffix_vote_drafts(
+        params, jnp.asarray(ids), jnp.asarray([3], jnp.int32), window=3,
+    )
+    assert [int(t) for t in drafts[0]] == [5, 5]
+
+
 def test_spec_acceptance_on_repetitive_chain(tiny):
-    """Zero params -> constant greedy chain -> the bigram lookup drafts it
+    """Zero params -> constant greedy chain -> the suffix lookup drafts it
     perfectly and iterations collapse to ~max_new/window."""
     cfg, _ = tiny
     params = jax.tree_util.tree_map(
