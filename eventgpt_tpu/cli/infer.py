@@ -82,10 +82,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh_model", type=int, default=1,
                    help="tensor-parallel axis of the serving mesh")
     p.add_argument("--speculative", type=int, default=0,
-                   help="speculative decode window (n-gram draft + K-token "
-                        "verify; exact greedy chain at temperature 0, exact "
-                        "sampling distribution above; num_beams must be 1; "
-                        "0 = off)")
+                   help="speculative decode window (suffix-lookup draft + "
+                        "K-token verify; exact greedy chain at temperature "
+                        "0, exact sampling distribution above; num_beams "
+                        "must be 1; 0 = off)")
+    p.add_argument("--draft_head", default=None,
+                   help="path to a trained Medusa head stack (.npz from "
+                        "train.medusa.save_medusa); replaces the lookup "
+                        "draft when --speculative > 0")
     p.add_argument("--timing", action="store_true", help="print stage timings to stderr")
     # Q-Former serving (the use_event_qformer surface): enable the gate and
     # load the trained component artifacts written by the trainer
@@ -95,6 +99,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pretrain_query_embedder", type=str, default=None)
     p.add_argument("--pretrain_attention_layers", type=str, default=None)
     return p
+
+
+def _load_draft_head(path: str):
+    from eventgpt_tpu.train.medusa import load_medusa
+
+    return load_medusa(path)
 
 
 def load_model(model_path: str, dtype: str, attn_impl=None, tokenizer_path=None):
@@ -295,6 +305,8 @@ def main(argv=None) -> str:
         kv_quant=args.kv_cache == "int8",
         mesh=mesh,
         speculative=args.speculative,
+        draft_head=(None if args.draft_head is None else
+                    _load_draft_head(args.draft_head)),
     )[0]
     t_gen = time.perf_counter() - t0
 

@@ -177,16 +177,21 @@ def _pad_batch(embeds: List[jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray, np.
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "last_only"), donate_argnames=("cache",)
+    jax.jit,
+    static_argnames=("cfg", "last_only", "return_hidden"),
+    donate_argnames=("cache",),
 )
-def _prefill_jit(params, cfg: EventChatConfig, embeds, mask, cache, last_only=False):
+def _prefill_jit(params, cfg: EventChatConfig, embeds, mask, cache,
+                 last_only=False, return_hidden=False):
     return llama_mod.prefill(
-        params["llama"], cfg.llama, embeds, mask, cache, last_only=last_only
+        params["llama"], cfg.llama, embeds, mask, cache, last_only=last_only,
+        return_hidden=return_hidden,
     )
 
 
 @functools.lru_cache(maxsize=32)
-def _get_sharded_prefill(cfg: EventChatConfig, flat_sh, treedef, logits_sh, mesh):
+def _get_sharded_prefill(cfg: EventChatConfig, flat_sh, treedef, logits_sh,
+                         mesh, hidden_sh=None):
     """Serving-mesh prefill with pinned output shardings.
 
     Without the pin, GSPMD is free to lay the written cache out differently
@@ -196,8 +201,19 @@ def _get_sharded_prefill(cfg: EventChatConfig, flat_sh, treedef, logits_sh, mesh
     shardings): one compile per serving configuration. ``mesh`` reaches
     ``llama_mod.prefill`` so a flash config runs the kernel per-shard
     (``serving_flash_shard_map``) instead of downgrading to dense scores.
+    ``hidden_sh`` (set by the Medusa draft path) additionally returns the
+    last real token's final-norm hidden state.
     """
     cache_sh = jax.tree_util.tree_unflatten(treedef, list(flat_sh))
+    if hidden_sh is not None:
+        return jax.jit(
+            lambda params, embeds, mask, cache: llama_mod.prefill(
+                params["llama"], cfg.llama, embeds, mask, cache,
+                last_only=True, mesh=mesh, return_hidden=True,
+            ),
+            donate_argnums=(3,),
+            out_shardings=(logits_sh, hidden_sh, cache_sh),
+        )
     return jax.jit(
         lambda params, embeds, mask, cache: llama_mod.prefill(
             params["llama"], cfg.llama, embeds, mask, cache, last_only=True,
@@ -208,7 +224,8 @@ def _get_sharded_prefill(cfg: EventChatConfig, flat_sh, treedef, logits_sh, mesh
     )
 
 
-def _prefill_sharded(params, cfg: EventChatConfig, embeds, mask, cache, mesh):
+def _prefill_sharded(params, cfg: EventChatConfig, embeds, mask, cache, mesh,
+                     return_hidden=False):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from eventgpt_tpu.parallel.serving import serving_batch_axes
@@ -216,14 +233,17 @@ def _prefill_sharded(params, cfg: EventChatConfig, embeds, mask, cache, mesh):
     cache_sh = jax.tree_util.tree_map(lambda x: x.sharding, cache)
     flat, treedef = jax.tree_util.tree_flatten(cache_sh)
     baxes = serving_batch_axes(mesh, embeds.shape[0])
+    bspec = baxes if baxes else None
     model_n = mesh.shape.get("model", 1)
     vocab_ax = (
         "model"
         if model_n > 1 and cfg.llama.vocab_size % model_n == 0
         else None
     )
-    logits_sh = NamedSharding(mesh, P(baxes if baxes else None, vocab_ax))
-    fn = _get_sharded_prefill(cfg, tuple(flat), treedef, logits_sh, mesh)
+    logits_sh = NamedSharding(mesh, P(bspec, vocab_ax))
+    hidden_sh = NamedSharding(mesh, P(bspec, None)) if return_hidden else None
+    fn = _get_sharded_prefill(cfg, tuple(flat), treedef, logits_sh, mesh,
+                              hidden_sh)
     return fn(params, embeds, mask, cache)
 
 
@@ -574,6 +594,10 @@ def _spec_draft_verify(
     top_p: float,
     eos: int,
     history=None,    # optional (H,) server-wide served-text lookup buffer
+    medusa=None,     # optional trained draft heads (models/medusa.py)
+    drafts_in=None,  # (B, W-1) drafts carried from the previous window
+                     # (Medusa mode: heads ran at the last correction's
+                     # hidden state, one iteration ago)
 ):
     """THE speculative draft-and-verify step, shared by the one-shot loop
     (``_spec_loop_jit``) and the serving segment
@@ -582,16 +606,21 @@ def _spec_draft_verify(
 
     Drafts window-1 tokens by longest-suffix majority-vote lookup over
     ``ids_buf[:, :pos]`` (+ the optional server ``history`` buffer —
-    ``_suffix_vote_drafts``), verifies the window in one ``decode_kstep``
-    (greedy argmax at temperature 0, rejection sampling otherwise), and
-    builds the commit window. The cache is returned with ``length``
-    RESTORED to its entry value — the caller advances it by however many
-    tokens it actually commits (budget caps differ between callers).
+    ``_suffix_vote_drafts``) — or, when ``medusa`` is given, consumes the
+    trained-head drafts carried in ``drafts_in`` and emits the NEXT
+    window's drafts from the correction position's hidden state. Either
+    way the window is verified in one ``decode_kstep`` (greedy argmax at
+    temperature 0, rejection sampling otherwise) and the commit window
+    built identically — draft quality affects speed, never the chain.
+    The cache is returned with ``length`` RESTORED to its entry value —
+    the caller advances it by however many tokens it actually commits
+    (budget caps differ between callers).
 
     Returns (commit (B, W), m_count (B,), first_eos (B,), hit (B,),
-    cache, key): ``commit[:, :m]`` are committable tokens, ``m_count`` the
-    un-capped commit count (accepted + correction), ``first_eos``/``hit``
-    locate an EOS inside the commit prefix.
+    cache, key, next_drafts): ``commit[:, :m]`` are committable tokens,
+    ``m_count`` the un-capped commit count (accepted + correction),
+    ``first_eos``/``hit`` locate an EOS inside the commit prefix;
+    ``next_drafts`` echoes ``drafts_in`` in lookup mode.
     """
     b, s_ids = ids_buf.shape
     bidx = jnp.arange(b)
@@ -599,14 +628,22 @@ def _spec_draft_verify(
     sampled = temperature > 0.0
 
     c0 = ids_buf[bidx, jnp.maximum(pos - 1, 0)]  # newest committed token
-    drafts = _suffix_vote_drafts(params, ids_buf, pos, window, history)
+    if medusa is not None:
+        drafts = drafts_in
+    else:
+        drafts = _suffix_vote_drafts(params, ids_buf, pos, window, history)
 
     wtoks = jnp.concatenate([c0[:, None], drafts], axis=1)  # (B, W)
     prev_len = cache["length"]
     embeds = llama_mod.embed_tokens(params["llama"], wtoks)
-    logits, cache = llama_mod.decode_kstep(
-        params["llama"], cfg.llama, embeds, cache
-    )
+    if medusa is not None:
+        logits, hidden, cache = llama_mod.decode_kstep(
+            params["llama"], cfg.llama, embeds, cache, return_hidden=True
+        )
+    else:
+        logits, cache = llama_mod.decode_kstep(
+            params["llama"], cfg.llama, embeds, cache
+        )
     if sampled:
         key, ku, kc = jax.random.split(key, 3)
         p = _spec_probs(logits, temperature, top_p)
@@ -626,7 +663,19 @@ def _spec_draft_verify(
     first_eos = jnp.min(jnp.where(is_eos, iarr, window), axis=1)
     hit = first_eos < window
     cache = {**cache, "length": prev_len}
-    return commit, m_count, first_eos, hit, cache, key
+    if medusa is not None:
+        from eventgpt_tpu.models import medusa as medusa_mod
+
+        # The correction token was sampled from position ``a``'s logits;
+        # the heads at that SAME position's hidden predict the tokens
+        # after it — the next window's drafts, with no extra forward.
+        x_sel = hidden[bidx, a]  # (B, D)
+        next_drafts = medusa_mod.medusa_drafts(
+            params["llama"], medusa, x_sel, window - 1
+        )
+    else:
+        next_drafts = drafts_in
+    return commit, m_count, first_eos, hit, cache, key, next_drafts
 
 
 @functools.partial(
@@ -648,11 +697,17 @@ def _spec_loop_jit(
     temperature: float = 0.0,
     top_p: float = 1.0,
     key=None,
+    medusa=None,
+    first_drafts=None,
 ):
-    """Speculative decoding: n-gram (prompt-lookup) drafting + one K-token
-    verification forward per iteration. Greedy (temperature 0) or sampled
-    (temperature > 0, nucleus top_p — the reference's default run shape,
-    ``inference.py:19-22``).
+    """Speculative decoding: lookup (or trained-head) drafting + one
+    K-token verification forward per iteration. Greedy (temperature 0) or
+    sampled (temperature > 0, nucleus top_p — the reference's default run
+    shape, ``inference.py:19-22``). With ``medusa`` (models/medusa.py),
+    drafts come from the trained heads instead of the suffix lookup:
+    ``first_drafts`` seeds the first window (heads applied to the prefill
+    hidden), and each verify step emits the next window's drafts from the
+    correction position's hidden — same exactness contracts either way.
 
     Decode at batch 1 is weight-bandwidth-bound (PERFORMANCE.md): one
     ``decode_step`` streams ~3.4 GB of int8 weights to emit ONE token. A
@@ -698,18 +753,22 @@ def _spec_loop_jit(
     ids_buf0 = ids_buf.at[bidx, prompt_lens].set(t0)
     n_gen0 = jnp.ones((b,), jnp.int32)
     done0 = t0 == eos
+    drafts0 = (first_drafts if medusa is not None
+               else jnp.zeros((b, max(window - 1, 0)), jnp.int32))
 
     def cond(state):
-        _, n_gen, done, _, _, _ = state
+        _, n_gen, done, _, _, _, _ = state
         return (~done & (n_gen < max_new_tokens)).any()
 
     def body(state):
-        ids_buf, n_gen, done, cache, n_iters, key = state
+        ids_buf, n_gen, done, cache, n_iters, key, drafts = state
         active = ~done & (n_gen < max_new_tokens)
         pos = prompt_lens + n_gen          # next ids_buf write slot
-        commit, m_count, first_eos, hit, cache, key = _spec_draft_verify(
-            params, cfg, ids_buf, pos, cache, key, window,
-            temperature, top_p, eos,
+        commit, m_count, first_eos, hit, cache, key, drafts = (
+            _spec_draft_verify(
+                params, cfg, ids_buf, pos, cache, key, window,
+                temperature, top_p, eos, medusa=medusa, drafts_in=drafts,
+            )
         )
         # EOS stops the commit window at (and including) the EOS token;
         # this loop allows budget overshoot (clipped at readback).
@@ -726,10 +785,11 @@ def _spec_loop_jit(
         # above length are masked everywhere and overwritten by the next
         # window).
         cache = {**cache, "length": cache["length"] + m_eff}
-        return ids_buf, n_gen, done, cache, n_iters + 1, key
+        return ids_buf, n_gen, done, cache, n_iters + 1, key, drafts
 
-    ids_buf, n_gen, done, cache, n_iters, _ = lax.while_loop(
-        cond, body, (ids_buf0, n_gen0, done0, cache, jnp.int32(0), key)
+    ids_buf, n_gen, done, cache, n_iters, _, _ = lax.while_loop(
+        cond, body,
+        (ids_buf0, n_gen0, done0, cache, jnp.int32(0), key, drafts0),
     )
     return ids_buf, n_gen, n_iters, cache
 
@@ -756,6 +816,7 @@ def generate(
     mesh=None,
     speculative: int = 0,
     spec_stats: Optional[Dict[str, int]] = None,
+    draft_head=None,
 ) -> List[List[int]]:
     """Autoregressive generation over a batch of event-QA prompts.
 
@@ -773,10 +834,13 @@ def generate(
     vs the reference's single-GPU ``inference.py:52-63``).
 
     ``speculative``: verify-window size K > 0 enables speculative decoding
-    (n-gram draft + K-token verify, ``_spec_loop_jit``) — at temperature 0
-    exactly the plain greedy chain; at temperature > 0 rejection-sampled
-    to the exact sampling distribution. Usually far fewer weight-streaming
-    passes. Composes with ``kv_quant`` and ``mesh``; requires num_beams 1.
+    (suffix-lookup draft + K-token verify, ``_spec_loop_jit``) — at
+    temperature 0 exactly the plain greedy chain; at temperature > 0
+    rejection-sampled to the exact sampling distribution. Usually far
+    fewer weight-streaming passes. Composes with ``kv_quant`` and
+    ``mesh``; requires num_beams 1. ``draft_head``: a trained Medusa stack
+    (``models/medusa.py``) switches drafting from lookup to the learned
+    heads (needs >= speculative-1 heads); same exactness contracts.
 
     ``input_ids_batch``: token ids containing -200 sentinels.
     ``pixel_values_batch``: (B, T_frames, C, H, W).
@@ -838,10 +902,18 @@ def generate(
         mask = serving.shard_batch_array(mask, mesh)
         cache = serving.shard_kv_cache(cache, cfg.llama, mesh)
 
+    want_hidden = bool(speculative) and draft_head is not None
+    last_hidden = None
     if serving is not None:
-        last_logits, cache = _prefill_sharded(params, cfg, padded, mask, cache, mesh)
+        pre = _prefill_sharded(params, cfg, padded, mask, cache, mesh,
+                               return_hidden=want_hidden)
     else:
-        last_logits, cache = _prefill_jit(params, cfg, padded, mask, cache, True)
+        pre = _prefill_jit(params, cfg, padded, mask, cache, True,
+                           return_hidden=want_hidden)
+    if want_hidden:
+        last_logits, last_hidden, cache = pre
+    else:
+        last_logits, cache = pre
 
     key = jax.random.PRNGKey(seed)
     if serving is not None:
@@ -890,10 +962,18 @@ def generate(
             # GSPMD partitions it like the plain decode loop.
             ids_buf = serving.shard_batch_array(ids_buf, mesh)
             plens = serving.shard_batch_array(plens, mesh)
+        first_drafts = None
+        if draft_head is not None:
+            from eventgpt_tpu.models import medusa as medusa_mod
+
+            first_drafts = medusa_mod.medusa_drafts(
+                params["llama"], draft_head, last_hidden, window - 1
+            )
         out_buf, n_gen, n_iters, cache = _spec_loop_jit(
             params, cfg, last_logits, cache, ids_buf, plens,
             max_new_tokens, window, int(eos),
             temperature=float(temperature), top_p=float(top_p), key=key,
+            medusa=draft_head, first_drafts=first_drafts,
         )
         del cache  # returned only for donation aliasing
         out_np = np.asarray(jax.device_get(out_buf))

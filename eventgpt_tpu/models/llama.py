@@ -316,6 +316,7 @@ def prefill(
     cache: KVCache,
     last_only: bool = False,
     mesh=None,
+    return_hidden: bool = False,
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Run the full prompt; returns (logits, filled cache).
 
@@ -403,12 +404,21 @@ def prefill(
         "length": lengths,
     }
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    # return_hidden uniformly appends the final-norm hidden as a THIRD
+    # element — (B, D) at the last real token with last_only, (B, T, D)
+    # otherwise (Medusa head seeding / training, models/medusa.py). A
+    # caller that ignores unused outputs pays nothing: XLA dead-code
+    # eliminates the lm_head matmul when only the hidden is consumed.
     if last_only:
         last = jnp.take_along_axis(
             x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
         )[:, 0]  # (B, D)
+        if return_hidden:
+            return _mm_f32(last, params["lm_head"]), last, new_cache
         return _mm_f32(last, params["lm_head"]), new_cache
     logits = _mm_f32(x, params["lm_head"])
+    if return_hidden:
+        return logits, x, new_cache
     return logits, new_cache
 
 
@@ -473,6 +483,7 @@ def decode_kstep(
     cfg: LlamaConfig,
     token_embeds: jnp.ndarray,
     cache: KVCache,
+    return_hidden: bool = False,
 ) -> Tuple[jnp.ndarray, KVCache]:
     """K-token verification step for speculative decoding.
 
@@ -529,6 +540,11 @@ def decode_kstep(
     new_cache = {"k": k_all, "v": v_all, "length": cache["length"] + kq}
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _mm_f32(x, params["lm_head"])  # (B, K, V)
+    if return_hidden:
+        # Per-window-position final-norm hidden: the Medusa draft path
+        # selects the correction position's hidden to seed the next
+        # window's drafts (models/eventchat._spec_draft_verify).
+        return logits, x, new_cache
     return logits, new_cache
 
 

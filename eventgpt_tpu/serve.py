@@ -179,7 +179,7 @@ def _spec_segment(
         it, ids_buf, n_new, done, cache, key = state
         active = ~(frozen | done) & (n_new < n_rem)
         pos = base_pos + n_new
-        commit, m_count, first_eos, hit, cache, key = _spec_draft_verify(
+        commit, m_count, first_eos, hit, cache, key, _ = _spec_draft_verify(
             params, cfg, ids_buf, pos, cache, key, window,
             temperature, top_p, eos, history=history,
         )

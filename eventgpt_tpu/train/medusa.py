@@ -1,0 +1,117 @@
+"""Training harness for the Medusa draft heads (``models/medusa.py``).
+
+Stage-2-shaped recipe: the WHOLE EventChat model is frozen (the same
+frozen-tree mechanism as ``train/steps.py`` — gradients flow only into the
+trainable argument, no requires_grad bookkeeping as in the reference's
+trainer, ``model/common/train.py``); the trainable set is just the
+(K, D, D) head stack. The forward reuses ``multimodal_embeds`` +
+``llama.prefill(return_hidden=True)`` so heads train on exactly the hidden
+states the decode path will feed them, event splice included.
+
+Head k learns P(token_{t+k+2} | hidden_t): the base lm_head owns offset
++1, the heads own the rest of the verification window. Run it after
+stage 2 on the finetune mixture — a few hundred steps of a 3-head stack
+is the paper's regime for 2-3x accepted tokens per iteration; acceptance
+on this framework's transcripts is measured by
+``scripts/spec_acceptance_sim.py`` for the lookup rule and by
+``spec_stats`` (``generate(..., draft_head=...)``) for trained heads.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.constants import IGNORE_INDEX
+from eventgpt_tpu.models import llama as llama_mod
+from eventgpt_tpu.models import medusa as medusa_mod
+from eventgpt_tpu.train.steps import TrainState, multimodal_embeds
+
+Batch = Dict[str, Any]
+
+
+def make_medusa_train_step(
+    cfg: EventChatConfig,
+    optimizer: optax.GradientTransformation,
+    donate: bool = True,
+):
+    """(state, batch) -> (state, metrics). ``state.trainable`` is the
+    Medusa param tree, ``state.frozen`` the full EventChat tree."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def step(state: TrainState, batch: Batch):
+        embeds = multimodal_embeds(state.frozen, cfg, batch)
+        mask = batch["attn_mask"]
+        # The logits output is unused here, so XLA DCEs the lm_head matmul
+        # — this forward costs hidden states only.
+        _, hidden, _ = llama_mod.prefill(
+            state.frozen["llama"], cfg.llama, embeds, mask,
+            llama_mod.init_kv_cache(
+                cfg.llama, embeds.shape[0], embeds.shape[1],
+                dtype=embeds.dtype,
+            ),
+            return_hidden=True,
+        )
+        hidden = jax.lax.stop_gradient(hidden)  # heads only; belt+braces
+
+        def loss_fn(medusa):
+            loss, per_head = medusa_mod.medusa_loss(
+                state.frozen["llama"], medusa, hidden, batch["labels"],
+                ignore_index=IGNORE_INDEX,
+            )
+            return loss, per_head
+
+        (loss, per_head), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.trainable)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.trainable
+        )
+        trainable = optax.apply_updates(state.trainable, updates)
+        new_state = TrainState(
+            trainable, state.frozen, opt_state, state.step + 1
+        )
+        return new_state, {
+            "loss": loss,
+            "per_head_loss": per_head,
+            "grad_norm": optax.global_norm(grads),
+        }
+
+    return step
+
+
+def init_medusa_state(
+    cfg: EventChatConfig,
+    params: Any,
+    num_heads: int,
+    optimizer: optax.GradientTransformation,
+    dtype=jnp.float32,
+) -> TrainState:
+    """Zero-initialized heads (identity start) + the frozen model tree."""
+    medusa = medusa_mod.init_medusa_params(cfg.llama, num_heads, dtype)
+    return TrainState(
+        trainable=medusa,
+        frozen=params,
+        opt_state=optimizer.init(medusa),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def save_medusa(path: str, medusa: Any) -> None:
+    import numpy as np
+
+    np.savez(path, w=np.asarray(medusa["w"]))
+
+
+def load_medusa(path: str, dtype=None):
+    import numpy as np
+
+    with np.load(path) as z:
+        w = z["w"]
+    arr = jnp.asarray(w) if dtype is None else jnp.asarray(w, dtype)
+    return {"w": arr}

@@ -70,6 +70,13 @@ class EventsDataIO {
   // of events popped. Non-blocking.
   size_t PopDataUntil(double horizon, std::vector<Event>& out);
 
+  // Offline-mode variant: waits until the producer has pushed packets
+  // covering ``horizon`` (or finished the stream) before draining.
+  // PopDataUntil alone races the producer thread — an early call can see
+  // an empty queue and return 0 events for a window the stream does
+  // cover (the feature-track generator's empty-npy flake).
+  size_t PopDataUntilBlocking(double horizon, std::vector<Event>& out);
+
   // True while the producer thread is alive or the queue is non-empty.
   bool Running() const;
 
@@ -94,6 +101,13 @@ class EventsDataIO {
 // Returns false on parse failure. Handles structured dtypes with x/y/t/p
 // fields of unsigned/signed integer or float types, little-endian.
 bool LoadEventsNpy(const std::string& path, std::vector<Event>& out);
+
+// Structured-array .npy writer (descr x:<u2, y:<u2, t:<f8, p:<u1) — the
+// exact layout LoadEventsNpy and the Python pipeline's
+// ops/raster.load_event_npy both read, so the offline feature-track
+// generator can emit training windows the JAX data pipeline consumes
+// directly (the SURVEY §2.3 seam).
+bool SaveEventsNpy(const std::string& path, const std::vector<Event>& events);
 bool LoadEventsTxt(const std::string& path, std::vector<Event>& out,
                    TimeUnit unit = TimeUnit::kAuto);
 

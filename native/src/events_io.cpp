@@ -147,6 +147,38 @@ bool LoadEventsNpy(const std::string& path, std::vector<Event>& out) {
   return true;
 }
 
+bool SaveEventsNpy(const std::string& path, const std::vector<Event>& events) {
+  // v1 .npy, structured dtype matching LoadEventsNpy's expectations and
+  // the reference's sample files: t stored in MICROSECONDS (f8) so a
+  // write->read round trip through either reader is exact.
+  std::string descr =
+      "{'descr': [('x', '<u2'), ('y', '<u2'), ('t', '<f8'), ('p', '<u1')], "
+      "'fortran_order': False, 'shape': (" +
+      std::to_string(events.size()) + ",), }";
+  const size_t base = 6 + 2 + 2;  // magic + version + u16 header len
+  size_t total = base + descr.size() + 1;  // +1 trailing newline
+  const size_t pad = (64 - (total % 64)) % 64;
+  descr.append(pad, ' ');
+  descr.push_back('\n');
+
+  std::ofstream f(path, std::ios::binary);
+  if (!f) return false;
+  f.write("\x93NUMPY", 6);
+  const uint8_t ver[2] = {1, 0};
+  f.write(reinterpret_cast<const char*>(ver), 2);
+  const uint16_t hl = static_cast<uint16_t>(descr.size());
+  f.write(reinterpret_cast<const char*>(&hl), 2);
+  f.write(descr.data(), static_cast<std::streamsize>(descr.size()));
+  for (const auto& e : events) {
+    const double t_us = e.t * 1e6;
+    f.write(reinterpret_cast<const char*>(&e.x), 2);
+    f.write(reinterpret_cast<const char*>(&e.y), 2);
+    f.write(reinterpret_cast<const char*>(&t_us), 8);
+    f.write(reinterpret_cast<const char*>(&e.p), 1);
+  }
+  return static_cast<bool>(f);
+}
+
 bool LoadEventsTxt(const std::string& path, std::vector<Event>& out,
                    TimeUnit unit) {
   std::ifstream f(path);
@@ -209,7 +241,14 @@ void EventsDataIO::ProduceFromVector(std::vector<Event> events) {
     }
   }
   if (!packet.events.empty() && !stop_requested_) PushData(std::move(packet));
-  producing_ = false;
+  {
+    // Flip under the mutex: a bare store + notify can fire between a
+    // waiter's predicate check and its block (the predicate runs under
+    // this mutex), losing the final wakeup — PopDataUntilBlocking would
+    // then sleep forever at exactly the end-of-stream case.
+    std::lock_guard<std::mutex> lock(mutex_);
+    producing_ = false;
+  }
   cv_.notify_all();
 }
 
@@ -239,6 +278,18 @@ void EventsDataIO::PushData(EventPacket&& packet) {
     queue_.push_back(std::move(packet));
   }
   cv_.notify_all();
+}
+
+size_t EventsDataIO::PopDataUntilBlocking(double horizon,
+                                          std::vector<Event>& out) {
+  {
+    std::unique_lock<std::mutex> lock(mutex_);
+    cv_.wait(lock, [&] {
+      if (!producing_) return true;  // stream finished: drain what exists
+      return !queue_.empty() && queue_.back().t_end > horizon;
+    });
+  }
+  return PopDataUntil(horizon, out);
 }
 
 size_t EventsDataIO::PopDataUntil(double horizon, std::vector<Event>& out) {

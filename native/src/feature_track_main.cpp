@@ -5,11 +5,17 @@
 // window around each feature).
 //
 // Usage:
-//   egpt_feature_track <config.yaml> <out.csv>
+//   egpt_feature_track <config.yaml> <out.csv> [npy_out_dir]
 //
 // Config keys (flat YAML, see egpt/config.hpp): rgb_* and event_* camera
 // blocks, data_path with frame_%06d.ppm / depth_%06d.pgm pairs, events.npy,
 // num_frames, frame_dt.
+//
+// With npy_out_dir, each tracked frame interval additionally writes its
+// popped events as events_%06d.npy (the structured {x,y,t,p} layout the
+// JAX pipeline's ops/raster.load_event_npy reads) — the SURVEY §2.3 seam:
+// eventgpt_tpu/data/feature_track.py turns tracks.csv + these windows into
+// auto-labeled motion-QA training samples for EventChatDataset.
 #include <cstdio>
 #include <fstream>
 #include <iostream>
@@ -81,6 +87,7 @@ int main(int argc, char** argv) {
     std::cerr << "cannot read config " << argv[1] << "\n";
     return 1;
   }
+  const std::string npy_dir = argc > 3 ? argv[3] : "";
   const auto cam_rgb = cfg->get_camera("rgb");
   const auto cam_event = cfg->get_camera("event");
   if (!cam_rgb || !cam_event) {
@@ -118,7 +125,23 @@ int main(int argc, char** argv) {
 
     if (have_events) {
       popped.clear();
-      events_io.PopDataUntil((fi + 1) * frame_dt, popped);
+      // Blocking form: the offline producer thread may not have reached
+      // this frame's horizon yet (the non-blocking pop is live-stream
+      // semantics and would silently emit an empty window).
+      events_io.PopDataUntilBlocking((fi + 1) * frame_dt, popped);
+      // This pop covers (fi*dt, (fi+1)*dt] — the motion interval of the
+      // NEXT frame's track rows (row frame=fi+1 records t0=fi*dt,
+      // t1=(fi+1)*dt), so the window is saved under fi+1. Saving it under
+      // fi would pair every training sample with the events AFTER its
+      // labeled motion.
+      if (!npy_dir.empty() && fi + 1 < num_frames) {
+        std::snprintf(namebuf, sizeof(namebuf), "%s/events_%06d.npy",
+                      npy_dir.c_str(), fi + 1);
+        if (!egpt::SaveEventsNpy(namebuf, popped)) {
+          std::cerr << "cannot write " << namebuf << "\n";
+          return 1;
+        }
+      }
     }
 
     if (fi > 0 && depth) {

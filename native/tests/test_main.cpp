@@ -212,6 +212,41 @@ static void TestNpyLoader() {
   std::remove(path);
 }
 
+static void TestNpyWriterRoundtripAndBlockingPop() {
+  // SaveEventsNpy -> LoadEventsNpy round trip, then the offline-mode
+  // blocking pop: an immediate PopDataUntilBlocking after GoOfflineNpy
+  // must see every event up to the horizon (the non-blocking pop races
+  // the producer thread and can return an empty window).
+  const char* path = "/tmp/egpt_test_events_rt.npy";
+  std::vector<Event> src;
+  for (int i = 0; i < 5000; ++i) {
+    Event e;
+    e.t = i * 1e-5;  // 0 .. 50 ms
+    e.x = static_cast<uint16_t>(i % 320);
+    e.y = static_cast<uint16_t>(i % 240);
+    e.p = static_cast<uint8_t>(i % 2);
+    src.push_back(e);
+  }
+  CHECK(SaveEventsNpy(path, src));
+  std::vector<Event> back;
+  CHECK(LoadEventsNpy(path, back));
+  CHECK(back.size() == src.size());
+  if (back.size() == src.size()) {
+    CHECK(back[4999].x == src[4999].x && back[4999].p == src[4999].p);
+    CHECK_NEAR(back[4999].t, src[4999].t, 1e-9);
+  }
+
+  EventsDataIO io;
+  CHECK(io.GoOfflineNpy(path));
+  std::vector<Event> first, rest;
+  io.PopDataUntilBlocking(0.025, first);   // immediately: must not race
+  CHECK(first.size() >= 2400 && first.size() <= 2600);
+  io.PopDataUntilBlocking(1.0, rest);      // past stream end: drains all
+  CHECK(first.size() + rest.size() == src.size());
+  io.Stop();
+  std::remove(path);
+}
+
 static void TestConfig() {
   const std::string yaml =
       "# rig config\n"
@@ -331,6 +366,7 @@ int main() {
   TestEventsThreaded();
   TestRaster();
   TestNpyLoader();
+  TestNpyWriterRoundtripAndBlockingPop();
   TestConfig();
   TestKLT();
   TestRansac();

@@ -1,0 +1,156 @@
+"""Native feature-track toolchain -> JAX training pipeline (SURVEY §2.3).
+
+Round 3 left the C++ generator write-only (CSV nothing consumed). This
+file proves the joined seam end-to-end: synthetic frames + events ->
+``egpt_feature_track`` (tracks.csv + per-interval {x,y,t,p} .npy windows
+via the new SaveEventsNpy) -> ``data/feature_track.tracks_to_dataset``
+(auto-labeled motion QA) -> ``EventChatDataset`` -> one real train step.
+"""
+
+import json
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BINARY = os.path.join(ROOT, "native", "build", "egpt_feature_track")
+
+pytestmark = pytest.mark.slow
+
+
+def _write_scene(d, w=160, h=120, shift=3, n_events=4000, frame_dt=0.033):
+    """Two frames of a textured scene rolled right by ``shift`` px, plus a
+    synthetic event stream (microsecond timestamps, matching the
+    reference's sample layout)."""
+    rng = np.random.default_rng(1)
+    base = (
+        120 + 60 * np.sin(np.arange(w)[None, :] * 0.12)
+        * np.cos(np.arange(h)[:, None] * 0.09)
+        + rng.normal(0, 2, (h, w))
+    ).clip(0, 255).astype(np.uint8)
+    for i, s in enumerate([0, shift]):
+        img = np.roll(base, s, axis=1)
+        rgb = np.repeat(img[:, :, None], 3, axis=2)
+        with open(os.path.join(d, f"frame_{i:06d}.ppm"), "wb") as f:
+            f.write(f"P6\n{w} {h}\n255\n".encode())
+            f.write(rgb.tobytes())
+        depth = np.full((h, w), 2000, np.uint16)
+        with open(os.path.join(d, f"depth_{i:06d}.pgm"), "wb") as f:
+            f.write(f"P5\n{w} {h}\n65535\n".encode())
+            f.write(depth.byteswap().tobytes())
+    ev = np.zeros(n_events, dtype=[("x", "<u2"), ("y", "<u2"),
+                                   ("t", "<f8"), ("p", "<u1")])
+    ev["x"] = rng.integers(0, w, n_events)
+    ev["y"] = rng.integers(0, h, n_events)
+    ev["t"] = np.sort(rng.uniform(0, 2 * frame_dt * 1e6, n_events))
+    ev["p"] = rng.integers(0, 2, n_events)
+    np.save(os.path.join(d, "events.npy"), ev)
+    cfg = os.path.join(d, "rig.yaml")
+    with open(cfg, "w") as f:
+        f.write(
+            f"data_path: {d}\n"
+            "num_frames: 2\n"
+            f"frame_dt: {frame_dt}\n"
+            "rgb_intrinsics: [200, 200, 80, 60]\n"
+            "rgb_resolution: [160, 120]\n"
+            "event_intrinsics: [200, 200, 80, 60]\n"
+            "event_resolution: [160, 120]\n"
+            "event_T_base_cam: 0 0 0 1 0.02 0 0\n"
+        )
+    return cfg
+
+
+def test_dominant_motion_label():
+    from eventgpt_tpu.data.feature_track import dominant_motion
+
+    rows = [{"prev_x": 10.0, "prev_y": 10.0, "cur_x": 13.0, "cur_y": 10.2},
+            {"prev_x": 50.0, "prev_y": 20.0, "cur_x": 53.1, "cur_y": 19.9},
+            {"prev_x": 90.0, "prev_y": 70.0, "cur_x": 92.9, "cur_y": 70.0}]
+    direction, speed, n = dominant_motion(rows)
+    assert direction == "right" and n == 3
+    assert 2.5 < speed < 3.5
+    rows_up = [{"prev_x": 10.0, "prev_y": 10.0, "cur_x": 10.0, "cur_y": 6.0}]
+    assert dominant_motion(rows_up)[0] == "up"  # image coords: -y is up
+
+
+@pytest.mark.skipif(not os.path.exists(BINARY),
+                    reason="egpt_feature_track not built")
+def test_save_events_npy_roundtrips_into_python(tmp_path):
+    """The C++ writer's output loads through the Python event reader with
+    microsecond timestamps intact (write->read->raster path)."""
+    from eventgpt_tpu.ops.raster import load_event_npy
+
+    d = str(tmp_path)
+    cfg = _write_scene(d)
+    out_csv = os.path.join(d, "tracks.csv")
+    npy_dir = os.path.join(d, "win")
+    os.makedirs(npy_dir)
+    res = subprocess.run([BINARY, cfg, out_csv, npy_dir],
+                         capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    win = os.path.join(npy_dir, "events_000001.npy")
+    assert os.path.exists(win)
+    ev = load_event_npy(win)
+    assert set(ev) >= {"x", "y", "t", "p"}
+    assert len(ev["x"]) > 100  # interval [0, dt] holds ~half the stream
+    assert float(ev["t"].max()) > 1e3  # microseconds, not seconds
+    # Window/label pairing: row frame=1 records motion over t in [0, dt],
+    # so its event window must cover exactly that interval — not the
+    # following one (the off-by-one a uniform-motion test can't catch).
+    assert float(ev["t"].max()) <= 0.033 * 1e6 * 1.001
+    # num_frames=2: the final interval has no track row -> no extra file.
+    assert not os.path.exists(os.path.join(npy_dir, "events_000002.npy"))
+
+
+@pytest.mark.skipif(not os.path.exists(BINARY),
+                    reason="egpt_feature_track not built")
+def test_feature_track_to_train_step(tmp_path):
+    """The full seam: C++ generator -> dataset JSON -> EventChatDataset ->
+    one finite train step. The C++ output is load-bearing."""
+    import jax
+
+    from eventgpt_tpu.config import EventChatConfig
+    from eventgpt_tpu.data.feature_track import (
+        MOTION_QUESTION, tracks_to_dataset,
+    )
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.models import eventchat
+    from eventgpt_tpu.train.trainer import (
+        DataArguments, ModelArguments, Trainer, TrainingArguments,
+    )
+
+    d = str(tmp_path)
+    cfg_path = _write_scene(d)
+    out_csv = os.path.join(d, "tracks.csv")
+    npy_dir = os.path.join(d, "win")
+    os.makedirs(npy_dir)
+    res = subprocess.run([BINARY, cfg_path, out_csv, npy_dir],
+                         capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+
+    out_json = os.path.join(d, "qa.json")
+    n = tracks_to_dataset(out_csv, npy_dir, out_json, min_tracks=3)
+    assert n >= 1
+    with open(out_json) as f:
+        entries = json.load(f)
+    assert MOTION_QUESTION in entries[0]["conversations"][0]["value"]
+    # The synthetic scene rolls right by 3 px; the auto-label must say so.
+    assert "right" in entries[0]["conversations"][1]["value"]
+
+    # One-sample dataset -> 2 train steps (global batch 1 on a 1x1 mesh).
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    targs = TrainingArguments(
+        output_dir=os.path.join(d, "out"), stage=1, max_steps=2,
+        per_device_train_batch_size=1, logging_steps=1, save_steps=-1,
+        bf16=False, learning_rate=1e-3, mesh_data=1, mesh_fsdp=1,
+    )
+    tr = Trainer(
+        cfg, params, load_tokenizer("byte"), ModelArguments(),
+        DataArguments(data_path=out_json, event_folder=npy_dir), targs,
+    )
+    metrics = tr.train()
+    assert metrics["step"] == 2
+    assert np.isfinite(metrics["loss"])
