@@ -89,7 +89,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--draft_head", default=None,
                    help="path to a trained Medusa head stack (.npz from "
                         "train.medusa.save_medusa); replaces the lookup "
-                        "draft when --speculative > 0")
+                        "draft (requires --speculative > 0)")
     p.add_argument("--timing", action="store_true", help="print stage timings to stderr")
     # Q-Former serving (the use_event_qformer surface): enable the gate and
     # load the trained component artifacts written by the trainer
@@ -269,6 +269,14 @@ def main(argv=None) -> str:
     args = build_parser().parse_args(argv)
     if args.num_beams < 1:
         raise ValueError(f"num_beams must be >= 1, got {args.num_beams}")
+    if args.draft_head is not None and not args.speculative:
+        # Loading heads without a verify window would silently run plain
+        # decode — the user would attribute plain-decode numbers to the
+        # trained heads.
+        raise ValueError(
+            "--draft_head requires --speculative K > 0 (the heads draft "
+            "into the K-token verification window)"
+        )
     from eventgpt_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()

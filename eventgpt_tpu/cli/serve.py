@@ -1,0 +1,429 @@
+"""Network-facing serving front-end over the continuous batcher.
+
+The reference's LLaVA lineage implies a controller/worker serving stack it
+never shipped (the heartbeat vestiges at
+``/root/reference/dataset/constants.py:1-4`` — CONTROLLER_HEART_BEAT_
+EXPIRATION etc. with no server behind them). This module is that surface,
+TPU-first: ONE process owns the chip and the resident decode batch
+(``eventgpt_tpu/serve.py``); a stdlib ThreadingHTTPServer front end feeds
+it through a thread-safe engine, so concurrency lives in the scheduler's
+row-level admission — not in process fan-out. A controller tier is not
+re-created: on TPU the accelerator is single-owner, and multi-host
+serving scales by sharding the batcher over the mesh
+(``--mesh_data/fsdp/model``), not by LLaVA's worker pools.
+
+Endpoints:
+  POST /v1/generate  {"query": str,
+                      "event_path": .npy path under --event_root |
+                      "event_b64": base64 .npy bytes,
+                      "max_new_tokens": int = 64,
+                      "stream": bool = false}
+      -> {"answer": str, "tokens": N, "ttft_s": x, "latency_s": y}
+      or (stream) chunked text deltas as they commit, newline-framed JSON.
+  GET  /health       -> {"status": "ok", "active": N, "queued": N}
+      (lock-free snapshot: answers inside a probe timeout even mid-segment)
+  GET  /stats        -> serverwide counters + recent request stats.
+
+``event_path`` is directory-allowlisted: without ``--event_root`` it is
+disabled entirely (clients upload streams inline via ``event_b64``), and
+with it the resolved path must stay inside the root.
+
+Smoke (tiny random weights):
+  python -m eventgpt_tpu.cli.serve --model_path tiny-random --port 8600 \
+      --event_root /root/reference/samples &
+  curl -s localhost:8600/v1/generate -d '{"query": "What is happening?",
+      "event_path": "sample1.npy"}'
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict
+
+
+class ServingEngine:
+    """Thread-safe wrapper around one ``ContinuousBatcher``.
+
+    The batcher itself is single-threaded by design (every method touches
+    resident device buffers); the engine serializes access behind one
+    lock and runs the scheduler loop on a dedicated thread, parking it
+    when no work exists. HTTP handler threads only do host-side prep
+    (event file -> pixels, tokenize) and block on per-request events.
+    """
+
+    def __init__(self, batcher, tokenizer, conv_mode: str = "eventgpt_v1"):
+        self.batcher = batcher
+        self.tokenizer = tokenizer
+        self.conv_mode = conv_mode
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._done: Dict[int, threading.Event] = {}
+        self._answers: Dict[int, list] = {}
+        self._streams: Dict[int, queue.Queue] = {}
+        self._sent: Dict[int, int] = {}
+        self.n_requests = 0
+        self.t_start = time.time()
+        # Lock-free stats snapshot: /health and /stats must answer inside
+        # a load balancer's probe timeout even while the scheduler thread
+        # holds the lock through a multi-second decode segment. Rebuilt
+        # after every step; staleness is bounded by one segment.
+        self._snapshot: Dict[str, Any] = self._build_snapshot()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- client side ------------------------------------------------------
+
+    def submit(self, query: str, pixels, max_new_tokens: int,
+               stream: bool = False) -> int:
+        from eventgpt_tpu.data.conversation import prepare_event_prompt
+        from eventgpt_tpu.data.tokenizer import tokenize_with_event
+
+        ids = tokenize_with_event(
+            prepare_event_prompt(query, self.conv_mode), self.tokenizer
+        )
+        with self._lock:
+            rid = self.batcher.submit(ids, pixels, max_new_tokens)
+            self._done[rid] = threading.Event()
+            if stream:
+                self._streams[rid] = queue.Queue()
+                self._sent[rid] = 0
+            self.n_requests += 1
+        self._wake.set()
+        return rid
+
+    def result(self, rid: int, timeout: float = 600.0):
+        """Block until the request finishes; returns its token ids."""
+        ev = self._done[rid]
+        if not ev.wait(timeout):
+            raise TimeoutError(f"request {rid} did not finish in {timeout}s")
+        with self._lock:
+            self._done.pop(rid, None)
+            return self._answers.pop(rid)
+
+    def stream_queue(self, rid: int) -> queue.Queue:
+        """Per-request queue of decoded-token deltas; None terminates."""
+        return self._streams[rid]
+
+    def _build_snapshot(self) -> Dict[str, Any]:
+        """Caller holds the lock (or the batcher is idle at init)."""
+        b = self.batcher
+        return {
+            "active_rows": sum(r is not None for r in b.rows),
+            "queued": len(b.queue),
+            "max_batch": b.max_batch,
+            "max_len": b.max_len,
+            "speculative": b.speculative,
+            "admission_s": round(b.admission_s, 3),
+            "recent": {
+                str(k): {kk: round(vv, 3) for kk, vv in v.items()}
+                for k, v in list(b.request_stats.items())[-8:]
+            },
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        # Lock-free by design (see _snapshot); counters are GIL-atomic.
+        return {
+            "uptime_s": round(time.time() - self.t_start, 1),
+            "requests": self.n_requests,
+            **self._snapshot,
+        }
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=10)
+
+    # -- scheduler thread -------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop:
+            with self._lock:
+                busy = (self.batcher.queue
+                        or any(r is not None for r in self.batcher.rows))
+                if busy:
+                    self.batcher.step()
+                    self._push_stream_deltas()
+                    self._harvest()
+                self._snapshot = self._build_snapshot()
+            if not busy:
+                self._wake.wait(timeout=0.1)
+                self._wake.clear()
+
+    def _push_stream_deltas(self) -> None:
+        for req in self.batcher.rows:
+            if req is None or req.rid not in self._streams:
+                continue
+            n = len(req.tokens)
+            if n > self._sent[req.rid]:
+                self._streams[req.rid].put(list(req.tokens[:n]))
+                self._sent[req.rid] = n
+
+    def _harvest(self) -> None:
+        if not self.batcher.finished:
+            return
+        done, self.batcher.finished = self.batcher.finished, {}
+        for rid, toks in done.items():
+            if rid in self._streams:
+                # Stream consumers hold their own queue reference; drop
+                # ALL engine-side state here — a streamed request never
+                # calls result(), so nothing else would (unbounded growth
+                # on a long-lived server otherwise; the batcher bounds
+                # request_stats for the same reason).
+                q = self._streams.pop(rid)
+                q.put(list(toks))
+                q.put(None)
+                self._sent.pop(rid, None)
+                self._done.pop(rid, None)
+                continue
+            self._answers[rid] = toks
+            if rid in self._done:
+                self._done[rid].set()
+
+
+def _decode_pixels(payload: Dict[str, Any], cfg, event_root=None):
+    """event_path (confined under --event_root) or event_b64 (inline npy)
+    -> pixel frames."""
+    import os
+
+    from eventgpt_tpu.ops.image import process_event_file
+
+    if "event_path" in payload:
+        # Network-facing file access is allowlisted by directory: without
+        # --event_root, server-local paths are disabled outright (clients
+        # upload via event_b64); with it, the resolved path must stay
+        # inside the root — no probing the server's filesystem.
+        if event_root is None:
+            raise ValueError(
+                "event_path is disabled (start the server with "
+                "--event_root DIR to serve files under DIR, or send the "
+                "stream inline via event_b64)"
+            )
+        root = os.path.realpath(event_root)
+        path = os.path.realpath(
+            os.path.join(root, str(payload["event_path"]).lstrip("/"))
+        )
+        if path != root and not path.startswith(root + os.sep):
+            raise ValueError("event_path escapes --event_root")
+        try:
+            _, pixels = process_event_file(
+                path, cfg.num_event_frames, cfg.vision.image_size
+            )
+        except FileNotFoundError:
+            raise ValueError(
+                f"no such event file under --event_root: "
+                f"{payload['event_path']}"
+            )
+        return pixels
+    if "event_b64" in payload:
+        import tempfile
+
+        raw = base64.b64decode(payload["event_b64"])
+        # Round-trip through a real file so one loader (load_event_npy's
+        # restricted unpickler included) serves both entry points.
+        with tempfile.NamedTemporaryFile(suffix=".npy") as f:
+            f.write(raw)
+            f.flush()
+            _, pixels = process_event_file(
+                f.name, cfg.num_event_frames, cfg.vision.image_size
+            )
+        return pixels
+    raise ValueError("request needs event_path or event_b64")
+
+
+def make_handler(engine: ServingEngine, cfg, event_root=None):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _json(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                s = engine.stats()
+                self._json(200, {"status": "ok",
+                                 "active": s["active_rows"],
+                                 "queued": s["queued"]})
+            elif self.path == "/stats":
+                self._json(200, engine.stats())
+            else:
+                self._json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/v1/generate":
+                self._json(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                query = payload["query"]
+                budget = int(payload.get("max_new_tokens", 64))
+                pixels = _decode_pixels(payload, cfg, event_root)
+            except Exception as e:  # bad request, not a server fault
+                self._json(400, {"error": str(e)})
+                return
+            stream = bool(payload.get("stream", False))
+            t0 = time.perf_counter()
+            try:
+                rid = engine.submit(query, pixels, budget, stream=stream)
+            except ValueError as e:
+                # submit()'s own validation (budget does not fit max_len,
+                # malformed sentinel count) is still the client's fault.
+                self._json(400, {"error": str(e)})
+                return
+            if stream:
+                try:
+                    self._stream_response(rid)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    # Client went away mid-stream. Never write a second
+                    # status line into a started chunked body; the engine
+                    # drains and drops the orphaned queue at harvest.
+                    pass
+                return
+            try:
+                toks = engine.result(rid)
+                text = engine.tokenizer.batch_decode(
+                    [toks], skip_special_tokens=True
+                )[0].strip()
+                stats = engine.batcher.request_stats.get(rid, {})
+                self._json(200, {
+                    "answer": text, "tokens": len(toks), "rid": rid,
+                    "ttft_s": round(stats.get("ttft_s", 0.0), 3),
+                    "latency_s": round(
+                        stats.get("latency_s",
+                                  time.perf_counter() - t0), 3),
+                })
+            except Exception as e:
+                self._json(500, {"error": str(e)})
+
+        def _stream_response(self, rid: int) -> None:
+            """Chunked transfer: one JSON line per delta — cumulative
+            decode each time (byte tokenizers can split multibyte chars
+            across segments, so deltas re-decode the full prefix)."""
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(obj) -> None:
+                line = (json.dumps(obj) + "\n").encode()
+                self.wfile.write(f"{len(line):x}\r\n".encode())
+                self.wfile.write(line + b"\r\n")
+
+            q = engine.stream_queue(rid)
+            last_text = ""
+            while True:
+                toks = q.get()
+                if toks is None:
+                    break
+                text = engine.tokenizer.batch_decode(
+                    [toks], skip_special_tokens=True
+                )[0]
+                if len(text) > len(last_text):
+                    chunk({"delta": text[len(last_text):], "rid": rid})
+                    last_text = text
+            chunk({"done": True, "rid": rid, "answer": last_text.strip()})
+            self.wfile.write(b"0\r\n\r\n")
+
+    return Handler
+
+
+def build_server(args) -> tuple:
+    """(ThreadingHTTPServer, ServingEngine) — separated from main() so
+    tests can run the real stack in-process on an ephemeral port."""
+    from eventgpt_tpu.cli.infer import load_model, prepare_model
+    from eventgpt_tpu.parallel.serving import (
+        build_serving_mesh, shard_params_for_serving,
+    )
+    from eventgpt_tpu.serve import ContinuousBatcher
+    from eventgpt_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    cfg, params, tokenizer = load_model(
+        args.model_path, args.dtype, None, args.tokenizer_path
+    )
+    cfg, params = prepare_model(cfg, params, tokenizer, args)
+    mesh = build_serving_mesh(args.mesh_data, args.mesh_fsdp, args.mesh_model)
+    if mesh is not None:
+        params = shard_params_for_serving(params, cfg, mesh)
+    batcher = ContinuousBatcher(
+        params, cfg, max_batch=args.max_batch, max_len=args.max_len,
+        chunk=args.chunk, temperature=args.temperature,
+        eos_token_id=getattr(tokenizer, "eos_token_id", None),
+        kv_quant=args.kv_cache == "int8", speculative=args.speculative,
+        mesh=mesh, prefill_chunk=args.prefill_chunk,
+    )
+    if args.warmup:
+        t0 = time.perf_counter()
+        n = batcher.warmup()
+        print(f"[serve] warmup: {n} executables in "
+              f"{time.perf_counter() - t0:.1f}s")
+    engine = ServingEngine(batcher, tokenizer, args.conv_mode)
+    httpd = ThreadingHTTPServer(
+        (args.host, args.port),
+        make_handler(engine, cfg, getattr(args, "event_root", None)),
+    )
+    return httpd, engine
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model_path", default="tiny-random")
+    p.add_argument("--tokenizer_path", default=None)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8600)
+    p.add_argument("--event_root", default=None,
+                   help="directory event_path requests resolve under; "
+                        "unset = server-local paths disabled (event_b64 "
+                        "only)")
+    p.add_argument("--conv_mode", default="eventgpt_v1")
+    p.add_argument("--max_batch", type=int, default=4)
+    p.add_argument("--max_len", type=int, default=1024)
+    p.add_argument("--chunk", type=int, default=128)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--max_new_tokens", type=int, default=64)
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=["bfloat16", "float32"])
+    p.add_argument("--quant", default="none", choices=["none", "int8", "int4"])
+    p.add_argument("--kv_cache", default="bf16", choices=["bf16", "int8"])
+    p.add_argument("--speculative", type=int, default=0)
+    p.add_argument("--prefill_chunk", type=int, default=0)
+    p.add_argument("--warmup", action="store_true")
+    p.add_argument("--mesh_data", type=int, default=1)
+    p.add_argument("--mesh_fsdp", type=int, default=1)
+    p.add_argument("--mesh_model", type=int, default=1)
+    # prepare_model (shared with infer/eval CLIs) reads these:
+    p.add_argument("--use_event_qformer", action="store_true")
+    p.add_argument("--pretrain_query_embedder", default=None)
+    p.add_argument("--pretrain_attention_layers", default=None)
+    args = p.parse_args(argv)
+
+    httpd, engine = build_server(args)
+    host, port = httpd.server_address[:2]
+    print(f"[serve] listening on http://{host}:{port} "
+          f"(max_batch={args.max_batch}, chunk={args.chunk})")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        engine.shutdown()
+        httpd.server_close()
+
+
+if __name__ == "__main__":
+    main()

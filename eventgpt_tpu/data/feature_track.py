@@ -106,3 +106,29 @@ def tracks_to_dataset(
     with open(out_json, "w") as f:
         json.dump(entries, f, indent=1)
     return len(entries)
+
+
+def main(argv=None):
+    """CLI: egpt_feature_track output -> dataset JSON.
+
+    python -m eventgpt_tpu.data.feature_track tracks.csv win/ qa.json
+    then train on it: cli.train --data_path qa.json --event_folder win/
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("tracks_csv")
+    p.add_argument("events_dir")
+    p.add_argument("out_json")
+    p.add_argument("--min_tracks", type=int, default=3)
+    p.add_argument("--min_speed", type=float, default=0.5)
+    args = p.parse_args(argv)
+    n = tracks_to_dataset(args.tracks_csv, args.events_dir, args.out_json,
+                          min_tracks=args.min_tracks,
+                          min_speed=args.min_speed)
+    print(f"wrote {n} samples to {args.out_json}")
+    return n
+
+
+if __name__ == "__main__":
+    main()

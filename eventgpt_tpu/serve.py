@@ -545,10 +545,21 @@ class ContinuousBatcher:
         stays FREE/frozen; its cache slots and logits are overwritten at
         the next real admission), and a segment with every row frozen
         exits its while_loop at entry — a no-op dispatch that still
-        compiles and caches the executable. Returns the number of warmed
+        compiles and caches the executable. That reasoning only holds on
+        an idle server — warming into a live row 0 (or zeroing active
+        cache lengths) would corrupt in-flight requests, so admission
+        must not have started yet.  Returns the number of warmed
         callables.
         """
         from eventgpt_tpu.models.eventchat import _prefill_jit, _prefill_sharded
+
+        if (self.queue or self._pending is not None
+                or any(r is not None for r in self.rows)):
+            raise RuntimeError(
+                "warmup() must run before any request is admitted: it "
+                "writes dummy state into row 0 and resets cache lengths, "
+                "which would corrupt in-flight rows"
+            )
 
         grain = 2 * SEQ_BUCKET
         if prompt_lens is None:
