@@ -402,6 +402,7 @@ def run_serve(args):
     assert len(first[r0]) == args.decode_tokens
 
     srv.admission_s = 0.0
+    srv.admission_max_s = 0.0
     t0 = time.perf_counter()
     rids = [srv.submit(ids, pixels, args.decode_tokens)
             for _ in range(n_req)]
@@ -424,6 +425,7 @@ def run_serve(args):
         "latency_p50_s": round(float(np.percentile(lats, 50)), 3),
         "latency_p99_s": round(float(np.percentile(lats, 99)), 3),
         "admission_stall_s": round(srv.admission_s, 3),
+        "admission_max_stall_s": round(srv.admission_max_s, 3),
         "first_request_s": round(t_first_req, 3),
         "warmup": bool(args.warmup),
         "warmup_s": round(t_warm, 3),

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import argparse
 import base64
+import itertools
 import json
 import queue
 import threading
@@ -70,6 +71,7 @@ class ServingEngine:
         self._sent: Dict[int, int] = {}
         self.n_requests = 0
         self.t_start = time.time()
+        self.fault: Any = None  # repr of a scheduler-thread death, if any
         # Lock-free stats snapshot: /health and /stats must answer inside
         # a load balancer's probe timeout even while the scheduler thread
         # holds the lock through a multi-second decode segment. Rebuilt
@@ -85,6 +87,8 @@ class ServingEngine:
         from eventgpt_tpu.data.conversation import prepare_event_prompt
         from eventgpt_tpu.data.tokenizer import tokenize_with_event
 
+        if self.fault is not None:
+            raise RuntimeError(f"serving engine is down: {self.fault}")
         ids = tokenize_with_event(
             prepare_event_prompt(query, self.conv_mode), self.tokenizer
         )
@@ -105,6 +109,8 @@ class ServingEngine:
             raise TimeoutError(f"request {rid} did not finish in {timeout}s")
         with self._lock:
             self._done.pop(rid, None)
+            if rid not in self._answers:
+                raise RuntimeError(f"serving engine is down: {self.fault}")
             return self._answers.pop(rid)
 
     def stream_queue(self, rid: int) -> queue.Queue:
@@ -121,9 +127,12 @@ class ServingEngine:
             "max_len": b.max_len,
             "speculative": b.speculative,
             "admission_s": round(b.admission_s, 3),
+            # reversed() on a dict view walks newest-first without
+            # materializing the (bounded-at-8192) stats map each step.
             "recent": {
-                str(k): {kk: round(vv, 3) for kk, vv in v.items()}
-                for k, v in list(b.request_stats.items())[-8:]
+                str(k): {kk: round(vv, 3)
+                         for kk, vv in b.request_stats[k].items()}
+                for k in itertools.islice(reversed(b.request_stats), 8)
             },
         }
 
@@ -144,17 +153,39 @@ class ServingEngine:
 
     def _loop(self) -> None:
         while not self._stop:
-            with self._lock:
-                busy = (self.batcher.queue
-                        or any(r is not None for r in self.batcher.rows))
-                if busy:
-                    self.batcher.step()
-                    self._push_stream_deltas()
-                    self._harvest()
-                self._snapshot = self._build_snapshot()
+            try:
+                with self._lock:
+                    busy = (self.batcher.queue
+                            or any(r is not None for r in self.batcher.rows))
+                    if busy:
+                        self.batcher.step()
+                        self._push_stream_deltas()
+                        self._harvest()
+                        # Snapshot only when state moved (idle polls would
+                        # rebuild 10x/s for nothing); submits wake the
+                        # loop, so queue growth shows within one pass.
+                        self._snapshot = self._build_snapshot()
+            except Exception as e:  # scheduler death must be LOUD
+                self._fail(e)
+                return
             if not busy:
                 self._wake.wait(timeout=0.1)
                 self._wake.clear()
+
+    def _fail(self, e: Exception) -> None:
+        """A step() exception would otherwise kill this daemon thread
+        silently while /health kept answering ok from the last snapshot
+        and every waiter burned its full timeout. Record the fault, wake
+        every waiter and stream, and refuse new work."""
+        self.fault = repr(e)
+        with self._lock:
+            for q in self._streams.values():
+                q.put(None)
+            self._streams.clear()
+            self._sent.clear()
+            for ev in self._done.values():
+                ev.set()  # result() sees no answer -> raises the fault
+            self.batcher.queue.clear()
 
     def _push_stream_deltas(self) -> None:
         for req in self.batcher.rows:
@@ -237,7 +268,8 @@ def _decode_pixels(payload: Dict[str, Any], cfg, event_root=None):
     raise ValueError("request needs event_path or event_b64")
 
 
-def make_handler(engine: ServingEngine, cfg, event_root=None):
+def make_handler(engine: ServingEngine, cfg, event_root=None,
+                 default_budget: int = 64):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -254,6 +286,10 @@ def make_handler(engine: ServingEngine, cfg, event_root=None):
 
         def do_GET(self):
             if self.path == "/health":
+                if engine.fault is not None:
+                    self._json(503, {"status": "fault",
+                                     "error": engine.fault})
+                    return
                 s = engine.stats()
                 self._json(200, {"status": "ok",
                                  "active": s["active_rows"],
@@ -271,7 +307,7 @@ def make_handler(engine: ServingEngine, cfg, event_root=None):
                 n = int(self.headers.get("Content-Length", "0"))
                 payload = json.loads(self.rfile.read(n) or b"{}")
                 query = payload["query"]
-                budget = int(payload.get("max_new_tokens", 64))
+                budget = int(payload.get("max_new_tokens", default_budget))
                 pixels = _decode_pixels(payload, cfg, event_root)
             except Exception as e:  # bad request, not a server fault
                 self._json(400, {"error": str(e)})
@@ -311,9 +347,14 @@ def make_handler(engine: ServingEngine, cfg, event_root=None):
                 self._json(500, {"error": str(e)})
 
         def _stream_response(self, rid: int) -> None:
-            """Chunked transfer: one JSON line per delta — cumulative
-            decode each time (byte tokenizers can split multibyte chars
-            across segments, so deltas re-decode the full prefix)."""
+            """Chunked transfer: one JSON line per delta. Deltas re-decode
+            the cumulative prefix each time, and hold back any trailing
+            U+FFFD replacement chars: a multibyte char split across decode
+            segments first decodes as \\ufffd and is REPLACED in the next
+            cumulative decode — emitted eagerly it would corrupt the
+            stream (a chunked body cannot retract bytes). Stripped tails
+            that never resolve (genuinely invalid bytes) flush in the
+            terminal delta, so concat(deltas) == the final answer."""
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
@@ -325,7 +366,8 @@ def make_handler(engine: ServingEngine, cfg, event_root=None):
                 self.wfile.write(line + b"\r\n")
 
             q = engine.stream_queue(rid)
-            last_text = ""
+            sent = ""
+            text = ""
             while True:
                 toks = q.get()
                 if toks is None:
@@ -333,10 +375,14 @@ def make_handler(engine: ServingEngine, cfg, event_root=None):
                 text = engine.tokenizer.batch_decode(
                     [toks], skip_special_tokens=True
                 )[0]
-                if len(text) > len(last_text):
-                    chunk({"delta": text[len(last_text):], "rid": rid})
-                    last_text = text
-            chunk({"done": True, "rid": rid, "answer": last_text.strip()})
+                stable = text.rstrip("�")
+                if stable.startswith(sent) and len(stable) > len(sent):
+                    chunk({"delta": stable[len(sent):], "rid": rid})
+                    sent = stable
+            if text.startswith(sent) and len(text) > len(sent):
+                chunk({"delta": text[len(sent):], "rid": rid})
+                sent = text
+            chunk({"done": True, "rid": rid, "answer": sent.strip()})
             self.wfile.write(b"0\r\n\r\n")
 
     return Handler
@@ -360,12 +406,18 @@ def build_server(args) -> tuple:
     mesh = build_serving_mesh(args.mesh_data, args.mesh_fsdp, args.mesh_model)
     if mesh is not None:
         params = shard_params_for_serving(params, cfg, mesh)
+    draft_head = None
+    if getattr(args, "draft_head", None):
+        from eventgpt_tpu.train.medusa import load_medusa
+
+        draft_head = load_medusa(args.draft_head)
     batcher = ContinuousBatcher(
         params, cfg, max_batch=args.max_batch, max_len=args.max_len,
         chunk=args.chunk, temperature=args.temperature,
         eos_token_id=getattr(tokenizer, "eos_token_id", None),
         kv_quant=args.kv_cache == "int8", speculative=args.speculative,
         mesh=mesh, prefill_chunk=args.prefill_chunk,
+        draft_head=draft_head,
     )
     if args.warmup:
         t0 = time.perf_counter()
@@ -375,7 +427,8 @@ def build_server(args) -> tuple:
     engine = ServingEngine(batcher, tokenizer, args.conv_mode)
     httpd = ThreadingHTTPServer(
         (args.host, args.port),
-        make_handler(engine, cfg, getattr(args, "event_root", None)),
+        make_handler(engine, cfg, getattr(args, "event_root", None),
+                     default_budget=getattr(args, "max_new_tokens", 64)),
     )
     return httpd, engine
 
@@ -401,6 +454,9 @@ def main(argv=None):
     p.add_argument("--quant", default="none", choices=["none", "int8", "int4"])
     p.add_argument("--kv_cache", default="bf16", choices=["bf16", "int8"])
     p.add_argument("--speculative", type=int, default=0)
+    p.add_argument("--draft_head", default=None,
+                   help="trained Medusa head stack (.npz) for speculative "
+                        "drafting (requires --speculative > 0)")
     p.add_argument("--prefill_chunk", type=int, default=0)
     p.add_argument("--warmup", action="store_true")
     p.add_argument("--mesh_data", type=int, default=1)

@@ -148,11 +148,18 @@ def _spec_segment(
     temperature: float = 0.0,
     top_p: float = 1.0,
     history=None,     # (H,) server-wide served-text lookup buffer
+    medusa=None,      # trained draft heads (models/medusa.py)
+    drafts=None,      # (B, W-1) per-row carried drafts (Medusa mode)
 ):
     """``n_iters`` speculative verify iterations over the shared batch —
-    the serving form of ``models/eventchat._spec_loop_jit`` (same bigram
-    drafting, same greedy/rejection-sampled verification) with per-row
-    budgets and a frozen mask, stopping for admission every segment.
+    the serving form of ``models/eventchat._spec_loop_jit`` (same
+    suffix-vote or trained-head drafting, same greedy/rejection-sampled
+    verification) with per-row budgets and a frozen mask, stopping for
+    admission every segment. In Medusa mode the drafts ride the loop
+    carry (each verify emits the next window's drafts from the correction
+    position's hidden); a row whose commit was budget-capped drops out of
+    ``live`` the same iteration, so stale drafts are never consumed —
+    admission reseeds them from the prefill hidden.
 
     Invariant per active row: ``cache["length"] == base_pos + n_new - 1``
     (every committed token except the newest has its KV cached; the
@@ -161,7 +168,7 @@ def _spec_segment(
     overshoot — the row may be harvested right after this segment), and a
     row is ``done`` only when its EOS lands within that cap.
 
-    Returns (ids_buf, n_new (B,), done (B,), cache, key).
+    Returns (ids_buf, n_new (B,), done (B,), cache, key, drafts).
     """
     from eventgpt_tpu.models.eventchat import _spec_draft_verify
 
@@ -169,19 +176,24 @@ def _spec_segment(
     bidx = jnp.arange(b)
     iarr = jnp.arange(window)[None, :]
     eos = eos_token_id
+    if drafts is None:
+        drafts = jnp.zeros((b, max(window - 1, 0)), jnp.int32)
 
     def cond(state):
-        it, _, n_new, done, _, _ = state
+        it, _, n_new, done, _, _, _ = state
         live = ~(frozen | done) & (n_new < n_rem)
         return (it < n_iters) & live.any()
 
     def body(state):
-        it, ids_buf, n_new, done, cache, key = state
+        it, ids_buf, n_new, done, cache, key, drafts = state
         active = ~(frozen | done) & (n_new < n_rem)
         pos = base_pos + n_new
-        commit, m_count, first_eos, hit, cache, key, _ = _spec_draft_verify(
-            params, cfg, ids_buf, pos, cache, key, window,
-            temperature, top_p, eos, history=history,
+        commit, m_count, first_eos, hit, cache, key, drafts = (
+            _spec_draft_verify(
+                params, cfg, ids_buf, pos, cache, key, window,
+                temperature, top_p, eos, history=history,
+                medusa=medusa, drafts_in=drafts,
+            )
         )
         # Unlike the one-shot loop, commits are CAPPED at the remaining
         # budget (the row may be harvested right after this segment) and a
@@ -197,14 +209,14 @@ def _spec_segment(
         n_new = n_new + m_eff
         done = done | (active & hit & (first_eos + 1 <= cap))
         cache = {**cache, "length": cache["length"] + m_eff}
-        return it + 1, ids_buf, n_new, done, cache, key
+        return it + 1, ids_buf, n_new, done, cache, key, drafts
 
-    _, ids_buf, n_new, done, cache, key = lax.while_loop(
+    _, ids_buf, n_new, done, cache, key, drafts = lax.while_loop(
         cond, body,
         (jnp.int32(0), ids_buf, jnp.zeros((b,), jnp.int32),
-         jnp.zeros((b,), bool), cache, key),
+         jnp.zeros((b,), bool), cache, key, drafts),
     )
-    return ids_buf, n_new, done, cache, key
+    return ids_buf, n_new, done, cache, key, drafts
 
 
 _spec_segment_jit = functools.partial(
@@ -254,20 +266,25 @@ def _chunk_prefill(params, cfg: EventChatConfig, embeds, cache,
     ``start`` must satisfy start+chunk <= S1 (the batcher validates that
     ``chunk`` divides the bucket grain, so dynamic_slice never clamps —
     a clamped slice would desynchronize embed positions from the cache
-    write slots). Returns (last_logits (1, V) f32 at window index
-    ``last_idx`` — the prompt's final real token on the finishing chunk,
-    unused otherwise — and the advanced cache).
+    write slots). Returns (last_logits (1, V) f32 and last_hidden (1, D)
+    at window index ``last_idx`` — the prompt's final real token on the
+    finishing chunk, unused otherwise — and the advanced cache).
     """
     emb = lax.dynamic_slice(
         embeds, (0, start, 0), (1, chunk, embeds.shape[-1])
     )
-    logits, cache = llama_mod.decode_kstep(
-        params["llama"], cfg.llama, emb, cache
+    logits, hidden, cache = llama_mod.decode_kstep(
+        params["llama"], cfg.llama, emb, cache, return_hidden=True
     )
     last = jnp.take_along_axis(
         logits, jnp.reshape(last_idx, (1, 1, 1)), axis=1
     )[:, 0]
-    return last, {**cache, "length": new_len}
+    # Final-norm hidden at the same position: seeds the Medusa drafts at
+    # admission (XLA DCEs it when the caller drops it).
+    last_hidden = jnp.take_along_axis(
+        hidden, jnp.reshape(last_idx, (1, 1, 1)), axis=1
+    )[:, 0]
+    return last, last_hidden, {**cache, "length": new_len}
 
 
 _chunk_prefill_jit = functools.partial(
@@ -318,18 +335,19 @@ def _get_sharded_decode_segment(
 @functools.lru_cache(maxsize=16)
 def _get_sharded_spec_segment(
     cfg, n_iters, window, eos_token_id, temperature, top_p,
-    flat_cache_sh, cache_treedef, ids_sh, b_sh, key_sh,
+    flat_cache_sh, cache_treedef, ids_sh, b_sh, key_sh, drafts_sh,
 ):
     cache_sh = jax.tree_util.tree_unflatten(cache_treedef, list(flat_cache_sh))
     return jax.jit(
-        lambda params, cache, key, ids_buf, base_pos, frozen, n_rem, history:
+        lambda params, cache, key, ids_buf, base_pos, frozen, n_rem, history,
+        medusa, drafts:
         _spec_segment(
             params, cfg, cache, key, ids_buf, base_pos, frozen, n_rem,
             n_iters, window, eos_token_id, temperature, top_p,
-            history=history,
+            history=history, medusa=medusa, drafts=drafts,
         ),
         donate_argnums=(1,),
-        out_shardings=(ids_sh, b_sh, b_sh, cache_sh, key_sh),
+        out_shardings=(ids_sh, b_sh, b_sh, cache_sh, key_sh, drafts_sh),
     )
 
 
@@ -344,7 +362,8 @@ def _get_sharded_admit(flat_cache_sh, cache_treedef, logits_sh):
 
 
 @functools.lru_cache(maxsize=16)
-def _get_sharded_chunk_prefill(cfg, chunk, flat_row_sh, row_treedef, last_sh):
+def _get_sharded_chunk_prefill(cfg, chunk, flat_row_sh, row_treedef, last_sh,
+                               hidden_sh):
     row_sh = jax.tree_util.tree_unflatten(row_treedef, list(flat_row_sh))
     return jax.jit(
         lambda params, embeds, cache, start, new_len, last_idx:
@@ -352,7 +371,7 @@ def _get_sharded_chunk_prefill(cfg, chunk, flat_row_sh, row_treedef, last_sh):
             params, cfg, embeds, cache, start, new_len, last_idx, chunk
         ),
         donate_argnums=(2,),
-        out_shardings=(last_sh, row_sh),
+        out_shardings=(last_sh, hidden_sh, row_sh),
     )
 
 
@@ -419,6 +438,7 @@ class ContinuousBatcher:
         mesh=None,
         prefill_chunk: int = 0,
         history_len: int = 2048,
+        draft_head=None,
     ):
         if prefill_chunk and (2 * SEQ_BUCKET) % prefill_chunk:
             # A chunk that does not divide the bucket grain would force
@@ -472,9 +492,32 @@ class ContinuousBatcher:
         # admission (the _spec_segment_jit invariant) so no logits state
         # carries between segments.
         self.speculative = int(speculative)
+        self.draft_head = draft_head
+        if draft_head is not None:
+            if not self.speculative:
+                raise ValueError(
+                    "draft_head requires speculative=K > 0 (the heads "
+                    "draft into the K-token verification window)"
+                )
+            from eventgpt_tpu.models.medusa import num_draft_heads
+
+            n_heads = num_draft_heads(draft_head)
+            if n_heads < self.speculative - 1:
+                # Validate at construction: the first medusa_drafts call
+                # otherwise raises at ADMISSION time, tearing down the
+                # serving loop mid-drain (the submit()-validation rule).
+                raise ValueError(
+                    f"draft_head has {n_heads} heads but speculative="
+                    f"{self.speculative} needs {self.speculative - 1}"
+                )
         if self.speculative:
             self.ids_buf = jnp.full((max_batch, self.max_len), -1, jnp.int32)
             self.base_pos = np.zeros((max_batch,), np.int64)
+            # Per-row carried drafts (consumed only in Medusa mode; a
+            # zeros dummy otherwise keeps the segment signature uniform).
+            self.spec_drafts = jnp.zeros(
+                (max_batch, max(self.speculative - 1, 0)), jnp.int32
+            )
         # Server-wide served-text history: a chronological buffer of prompt
         # text + committed answers across ALL requests, used as extra
         # lookup context by the speculative draft (_suffix_vote_drafts) —
@@ -495,10 +538,12 @@ class ContinuousBatcher:
         self._next_rid = 0
         self.prefill_chunk = int(prefill_chunk)
         self._pending: Optional[_PendingAdmission] = None
-        # Service metrics: wall time spent inside _admit (the stall decode
-        # rows experience per scheduling iteration) and per-request
-        # TTFT / completion latency, keyed by rid.
+        # Service metrics: wall time spent inside _admit (total, and the
+        # worst single scheduling iteration — the stall bound chunked
+        # prefill exists to cut) and per-request TTFT / completion
+        # latency, keyed by rid.
         self.admission_s = 0.0
+        self.admission_max_s = 0.0
         self.request_stats: Dict[int, Dict[str, float]] = {}
 
     def _init_mesh_placement(self, vocab: int) -> None:
@@ -526,6 +571,9 @@ class ContinuousBatcher:
         if self.speculative:
             self._ids_sh = NamedSharding(mesh, P(bspec, None))
             self.ids_buf = jax.device_put(self.ids_buf, self._ids_sh)
+            self._drafts_sh = NamedSharding(mesh, P(bspec, None))
+            self.spec_drafts = jax.device_put(self.spec_drafts,
+                                              self._drafts_sh)
         cache_sh = jax.tree_util.tree_map(lambda x: x.sharding, self.cache)
         flat, treedef = jax.tree_util.tree_flatten(cache_sh)
         self._cache_flat_sh, self._cache_treedef = tuple(flat), treedef
@@ -586,6 +634,7 @@ class ContinuousBatcher:
         )
         n += 1
         d = self.cfg.llama.hidden_size
+        want_hidden = self.draft_head is not None
         for s1 in buckets:
             padded = jnp.zeros((1, s1, d), self._dtype)
             mask = jnp.ones((1, s1), bool)
@@ -593,13 +642,16 @@ class ContinuousBatcher:
             if self.mesh is not None:
                 padded = self._serving.shard_batch_array(padded, self.mesh)
                 mask = self._serving.shard_batch_array(mask, self.mesh)
-                row_logits, row_cache = _prefill_sharded(
-                    self.params, self.cfg, padded, mask, row_cache, self.mesh
+                pre = _prefill_sharded(
+                    self.params, self.cfg, padded, mask, row_cache,
+                    self.mesh, return_hidden=want_hidden,
                 )
             else:
-                row_logits, row_cache = _prefill_jit(
-                    self.params, self.cfg, padded, mask, row_cache, True
+                pre = _prefill_jit(
+                    self.params, self.cfg, padded, mask, row_cache, True,
+                    return_hidden=want_hidden,
                 )
+            row_logits, row_cache = pre[0], pre[-1]
             n += 1
             if self.prefill_chunk:
                 # One chunk at this bucket's embed shape compiles the
@@ -609,6 +661,8 @@ class ContinuousBatcher:
                 new_len = jnp.asarray([1], jnp.int32)
                 last_idx = jnp.asarray(0, jnp.int32)
                 if self.mesh is not None:
+                    from jax.sharding import PartitionSpec as P
+
                     row_sh = jax.tree_util.tree_map(
                         lambda x: x.sharding, chunk_cache
                     )
@@ -616,6 +670,7 @@ class ContinuousBatcher:
                     fn = _get_sharded_chunk_prefill(
                         self.cfg, self.prefill_chunk, tuple(flat),
                         treedef, self._row_logits_sh,
+                        jax.sharding.NamedSharding(self.mesh, P(None, None)),
                     )
                     fn(self.params, padded, chunk_cache, start_arr,
                        new_len, last_idx)
@@ -701,7 +756,9 @@ class ContinuousBatcher:
 
         t0 = time.perf_counter()
         self._admit()
-        self.admission_s += time.perf_counter() - t0
+        dt_admit = time.perf_counter() - t0
+        self.admission_s += dt_admit
+        self.admission_max_s = max(self.admission_max_s, dt_admit)
         if all(r is None for r in self.rows):
             return
         if bool(self.frozen.all()):
@@ -747,19 +804,24 @@ class ContinuousBatcher:
                     self.temperature, self.top_p,
                     self._cache_flat_sh, self._cache_treedef,
                     self._ids_sh, self._b_sh, self._key_sh,
+                    self._drafts_sh,
                 )
-                self.ids_buf, n_new, done, self.cache, self.key = fn(
+                (self.ids_buf, n_new, done, self.cache, self.key,
+                 self.spec_drafts) = fn(
                     self.params, self.cache, self.key, self.ids_buf,
-                    base_pos, frozen, n_rem, history,
+                    base_pos, frozen, n_rem, history, self.draft_head,
+                    self.spec_drafts,
                 )
             else:
-                self.ids_buf, n_new, done, self.cache, self.key = (
+                (self.ids_buf, n_new, done, self.cache, self.key,
+                 self.spec_drafts) = (
                     _spec_segment_jit(
                         self.params, self.cfg, self.cache, self.key,
                         self.ids_buf, base_pos,
                         frozen, n_rem, n_iters, self.speculative,
                         int(self.eos), self.temperature, self.top_p,
-                        history=history,
+                        history=history, medusa=self.draft_head,
+                        drafts=self.spec_drafts,
                     )
                 )
             # Read back only the window a segment could have written
@@ -862,16 +924,26 @@ class ContinuousBatcher:
                 self._advance_pending()
                 break
             # No active rows to stall (or chunking disabled): one-shot
-            # prefill at the bucket length.
+            # prefill at the bucket length. Medusa mode also needs the
+            # prompt's last hidden to seed the row's first draft window.
+            want_hidden = self.draft_head is not None
+            row_hidden = None
             if self.mesh is not None:
-                row_logits, row_cache = _prefill_sharded(
-                    self.params, self.cfg, padded, mask, row_cache, self.mesh
+                pre = _prefill_sharded(
+                    self.params, self.cfg, padded, mask, row_cache,
+                    self.mesh, return_hidden=want_hidden,
                 )
             else:
-                row_logits, row_cache = _prefill_jit(
-                    self.params, self.cfg, padded, mask, row_cache, True
+                pre = _prefill_jit(
+                    self.params, self.cfg, padded, mask, row_cache, True,
+                    return_hidden=want_hidden,
                 )
-            self._finish_admission(req, row, prompt_len, row_cache, row_logits)
+            if want_hidden:
+                row_logits, row_hidden, row_cache = pre
+            else:
+                row_logits, row_cache = pre
+            self._finish_admission(req, row, prompt_len, row_cache,
+                                   row_logits, row_hidden)
 
     def _prep_request(self, req: _Request):
         """Host + encode prep for one admission: CLIP encode, splice, pad
@@ -911,7 +983,16 @@ class ContinuousBatcher:
 
     def _advance_pending(self) -> None:
         """Run one prefill chunk of the in-flight admission; on the final
-        chunk, insert the row into the shared cache and activate it."""
+        chunk, insert the row into the shared cache and activate it.
+        Starvation guard: when no row is actively decoding (nothing to
+        stall — chunk-per-step would just serialize the admission against
+        no-op segments), drain ALL remaining chunks at once."""
+        while self._pending is not None:
+            self._advance_pending_one()
+            if self._pending is None or not bool(self.frozen.all()):
+                return
+
+    def _advance_pending_one(self) -> None:
         p = self._pending
         c = self.prefill_chunk
         start = p.filled
@@ -922,19 +1003,23 @@ class ContinuousBatcher:
             max(0, min(p.prompt_len - 1 - start, c - 1)), jnp.int32
         )
         if self.mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
             row_sh = jax.tree_util.tree_map(
                 lambda x: x.sharding, p.row_cache
             )
             flat, treedef = jax.tree_util.tree_flatten(row_sh)
+            hidden_sh = jax.sharding.NamedSharding(self.mesh, P(None, None))
             fn = _get_sharded_chunk_prefill(
-                self.cfg, c, tuple(flat), treedef, self._row_logits_sh
+                self.cfg, c, tuple(flat), treedef, self._row_logits_sh,
+                hidden_sh,
             )
-            last, p.row_cache = fn(
+            last, last_hidden, p.row_cache = fn(
                 self.params, p.embeds, p.row_cache, start_arr, new_len,
                 last_idx,
             )
         else:
-            last, p.row_cache = _chunk_prefill_jit(
+            last, last_hidden, p.row_cache = _chunk_prefill_jit(
                 self.params, self.cfg, p.embeds, p.row_cache,
                 start_arr, new_len, last_idx, c,
             )
@@ -942,12 +1027,13 @@ class ContinuousBatcher:
         p.last_logits = last
         if p.filled >= p.prompt_len:
             self._finish_admission(
-                p.req, p.row, p.prompt_len, p.row_cache, last
+                p.req, p.row, p.prompt_len, p.row_cache, last,
+                last_hidden if self.draft_head is not None else None,
             )
             self._pending = None
 
     def _finish_admission(self, req, row, prompt_len, row_cache,
-                          row_logits) -> None:
+                          row_logits, row_hidden=None) -> None:
         """Insert the prefilled row into the shared cache + activate it."""
         if self.mesh is not None:
             admit = _get_sharded_admit(
@@ -960,6 +1046,21 @@ class ContinuousBatcher:
         )
         self.rows[row] = req
         req.row = row
+        if self.draft_head is not None and self.speculative > 1:
+            from eventgpt_tpu.models import medusa as medusa_mod
+
+            # Seed the row's first draft window from the prompt's last
+            # hidden (the heads at that position predict the tokens after
+            # the prefill-argmax commit — the _spec_segment carry rule).
+            row_drafts = medusa_mod.medusa_drafts(
+                self.params["llama"], self.draft_head, row_hidden,
+                self.speculative - 1,
+            )
+            self.spec_drafts = self.spec_drafts.at[row].set(row_drafts[0])
+            if self.mesh is not None:
+                self.spec_drafts = jax.device_put(
+                    self.spec_drafts, self._drafts_sh
+                )
         if self.speculative:
             self._admit_speculative(req, row, prompt_len, row_logits)
             return

@@ -175,6 +175,42 @@ def test_streaming_state_is_released(server):
     assert engine._answers == {} and engine._done == {}
 
 
+def test_engine_fault_is_loud():
+    """A scheduler-thread exception must not die silently: waiters get
+    the fault, submits refuse, health reports it (via engine.fault)."""
+    import jax
+    import numpy as np
+
+    from eventgpt_tpu.cli.serve import ServingEngine
+    from eventgpt_tpu.config import EventChatConfig
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.models import eventchat
+    from eventgpt_tpu.serve import ContinuousBatcher
+
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    srv = ContinuousBatcher(params, cfg, max_batch=1, max_len=256, chunk=4,
+                            eos_token_id=None)
+
+    def boom():
+        raise RuntimeError("boom")
+
+    srv.step = boom
+    engine = ServingEngine(srv, load_tokenizer("byte"))
+    try:
+        rng = np.random.default_rng(0)
+        pv = rng.normal(size=(cfg.num_event_frames, 3, cfg.vision.image_size,
+                              cfg.vision.image_size)).astype(np.float32)
+        rid = engine.submit("What is happening?", pv, 4)
+        with pytest.raises(RuntimeError, match="boom"):
+            engine.result(rid, timeout=60)
+        assert engine.fault and "boom" in engine.fault
+        with pytest.raises(RuntimeError, match="down"):
+            engine.submit("again?", pv, 4)
+    finally:
+        engine.shutdown()
+
+
 def test_warmup_after_admission_raises(server):
     """The batcher's warmup precondition: never on live rows."""
     _, engine = server
