@@ -69,6 +69,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="speculative decode window (exact greedy chain at "
                         "temperature 0, exact sampling distribution above; "
                         "num_beams must be 1)")
+    p.add_argument("--draft_head", default=None,
+                   help="trained Medusa head stack (.npz) for speculative "
+                        "drafting (requires --speculative > 0)")
     p.add_argument("--timing", action="store_true")
     return p
 
@@ -83,6 +86,13 @@ def main(argv=None):
     from eventgpt_tpu.cli.infer import (
         load_model, prepare_model, serving_mesh_from_args,
     )
+
+    if args.draft_head is not None and not args.speculative:
+        raise ValueError(
+            "--draft_head requires --speculative K > 0 (the heads draft "
+            "into the K-token verification window)"
+        )
+    from eventgpt_tpu.train.medusa import load_medusa
 
     files = [f for f in args.event_frames.split(",") if f]
     if args.queries_json:
@@ -132,6 +142,8 @@ def main(argv=None):
         kv_quant=args.kv_cache == "int8",
         mesh=mesh,
         speculative=args.speculative,
+        draft_head=(None if args.draft_head is None else
+                    load_medusa(args.draft_head)),
     )
     t_gen = time.perf_counter() - t0
 

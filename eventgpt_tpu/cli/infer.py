@@ -101,12 +101,6 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _load_draft_head(path: str):
-    from eventgpt_tpu.train.medusa import load_medusa
-
-    return load_medusa(path)
-
-
 def load_model(model_path: str, dtype: str, attn_impl=None, tokenizer_path=None):
     """Returns (config, host-or-device params, tokenizer).
 
@@ -277,6 +271,7 @@ def main(argv=None) -> str:
             "--draft_head requires --speculative K > 0 (the heads draft "
             "into the K-token verification window)"
         )
+    from eventgpt_tpu.train.medusa import load_medusa as _load_medusa
     from eventgpt_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
@@ -314,7 +309,7 @@ def main(argv=None) -> str:
         mesh=mesh,
         speculative=args.speculative,
         draft_head=(None if args.draft_head is None else
-                    _load_draft_head(args.draft_head)),
+                    _load_medusa(args.draft_head)),
     )[0]
     t_gen = time.perf_counter() - t0
 

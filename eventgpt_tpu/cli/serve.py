@@ -93,6 +93,11 @@ class ServingEngine:
             prepare_event_prompt(query, self.conv_mode), self.tokenizer
         )
         with self._lock:
+            # Re-check under the lock: a fault landing while we tokenized
+            # has already swept _done — an event registered after the
+            # sweep would burn its caller's full timeout.
+            if self.fault is not None:
+                raise RuntimeError(f"serving engine is down: {self.fault}")
             rid = self.batcher.submit(ids, pixels, max_new_tokens)
             self._done[rid] = threading.Event()
             if stream:
@@ -180,7 +185,9 @@ class ServingEngine:
         self.fault = repr(e)
         with self._lock:
             for q in self._streams.values():
-                q.put(None)
+                # A dict sentinel, not None: the stream handler must
+                # surface the fault, not end the body as a normal done.
+                q.put({"fault": self.fault})
             self._streams.clear()
             self._sent.clear()
             for ev in self._done.values():
@@ -372,6 +379,12 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                 toks = q.get()
                 if toks is None:
                     break
+                if isinstance(toks, dict):  # engine fault sentinel
+                    chunk({"done": True, "rid": rid,
+                           "error": toks["fault"],
+                           "answer": sent.strip()})
+                    self.wfile.write(b"0\r\n\r\n")
+                    return
                 text = engine.tokenizer.batch_decode(
                     [toks], skip_special_tokens=True
                 )[0]

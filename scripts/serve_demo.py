@@ -37,6 +37,9 @@ def main(argv=None):
     p.add_argument("--kv_cache", default="bf16", choices=["bf16", "int8"])
     p.add_argument("--speculative", type=int, default=0,
                    help="verify-window size K (0 = plain decode)")
+    p.add_argument("--draft_head", default=None,
+                   help="trained Medusa head stack (.npz) for speculative "
+                        "drafting (requires --speculative > 0)")
     p.add_argument("--warmup", action="store_true",
                    help="precompile every (bucket, segment) executable "
                         "before serving (ContinuousBatcher.warmup)")
@@ -79,12 +82,18 @@ def main(argv=None):
     if mesh is not None:
         params = shard_params_for_serving(params, cfg, mesh)
 
+    draft_head = None
+    if args.draft_head:
+        from eventgpt_tpu.train.medusa import load_medusa
+
+        draft_head = load_medusa(args.draft_head)
     srv = ContinuousBatcher(
         params, cfg, max_batch=args.max_batch, max_len=args.max_len,
         chunk=args.chunk, temperature=args.temperature,
         eos_token_id=getattr(tokenizer, "eos_token_id", None),
         kv_quant=args.kv_cache == "int8", speculative=args.speculative,
         mesh=mesh, prefill_chunk=args.prefill_chunk,
+        draft_head=draft_head,
     )
     if args.warmup:
         t0 = time.perf_counter()

@@ -132,6 +132,24 @@ def test_sharded_server_eos_stops_early(tiny, mesh8):
     assert out[rid] == want and len(out[rid]) < 12
 
 
+def test_sharded_server_all_features_composed(tiny, mesh8):
+    """The full stack at once — serving mesh + int8 KV + speculative
+    (suffix-vote + server history) + chunked admission prefill — commits
+    the same chains as plain one-shot kv-quant generate."""
+    cfg, params = tiny
+    sharded = shard_params_for_serving(params, cfg, mesh8)
+    srv = ContinuousBatcher(
+        sharded, cfg, mesh=mesh8, max_batch=2, max_len=256, chunk=4,
+        eos_token_id=None, kv_quant=True, speculative=4, prefill_chunk=8,
+        history_len=512,
+    )
+    rids = [srv.submit(ids, _pv(cfg, s), b) for ids, s, b in REQS]
+    out = srv.run_until_drained()
+    for rid, (ids, s, b) in zip(rids, REQS):
+        want = _oneshot(params, cfg, ids, _pv(cfg, s), b, kv_quant=True)
+        assert out[rid] == want, f"req {rid}"
+
+
 def test_13b_sharded_server_segment_compiles():
     """The 13B decode segment — the BASELINE config-5 serving hot loop —
     AOT-compiles over an fsdp=4 x model=2 mesh from abstract sharded
