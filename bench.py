@@ -401,8 +401,7 @@ def run_serve(args):
     t_first_req = time.perf_counter() - t0
     assert len(first[r0]) == args.decode_tokens
 
-    srv.admission_s = 0.0
-    srv.admission_max_s = 0.0
+    srv.reset_serving_stats()  # exclude the warmup/first-request phase
     t0 = time.perf_counter()
     rids = [srv.submit(ids, pixels, args.decode_tokens)
             for _ in range(n_req)]
@@ -433,6 +432,9 @@ def run_serve(args):
         "prefill_chunk": args.serve_prefill_chunk,
         "kv_cache": args.kv,
         "speculative": args.serve_spec,
+        **({"spec_tokens_per_iteration":
+            round(srv.spec_tokens_per_iteration(), 2)}
+           if args.serve_spec else {}),
         "quant": quant,
         "platform": platform,
     }

@@ -92,7 +92,7 @@ def main(argv=None):
             "--draft_head requires --speculative K > 0 (the heads draft "
             "into the K-token verification window)"
         )
-    from eventgpt_tpu.train.medusa import load_medusa
+    from eventgpt_tpu.models.medusa import load_medusa
 
     files = [f for f in args.event_frames.split(",") if f]
     if args.queries_json:

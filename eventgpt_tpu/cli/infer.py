@@ -271,7 +271,7 @@ def main(argv=None) -> str:
             "--draft_head requires --speculative K > 0 (the heads draft "
             "into the K-token verification window)"
         )
-    from eventgpt_tpu.train.medusa import load_medusa as _load_medusa
+    from eventgpt_tpu.models.medusa import load_medusa as _load_medusa
     from eventgpt_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()

@@ -119,7 +119,9 @@ class ServingEngine:
             return self._answers.pop(rid)
 
     def stream_queue(self, rid: int) -> queue.Queue:
-        """Per-request queue of decoded-token deltas; None terminates."""
+        """Per-request queue of cumulative token-id lists. Two sentinels:
+        ``None`` = request finished normally; a ``dict`` = engine fault
+        (``{"fault": repr}``) — consumers must surface it, not decode it."""
         return self._streams[rid]
 
     def _build_snapshot(self) -> Dict[str, Any]:
@@ -132,6 +134,9 @@ class ServingEngine:
             "max_len": b.max_len,
             "speculative": b.speculative,
             "admission_s": round(b.admission_s, 3),
+            **({"spec_tokens_per_iteration":
+                round(b.spec_tokens_per_iteration(), 2)}
+               if b.speculative else {}),
             # reversed() on a dict view walks newest-first without
             # materializing the (bounded-at-8192) stats map each step.
             "recent": {
@@ -421,7 +426,7 @@ def build_server(args) -> tuple:
         params = shard_params_for_serving(params, cfg, mesh)
     draft_head = None
     if getattr(args, "draft_head", None):
-        from eventgpt_tpu.train.medusa import load_medusa
+        from eventgpt_tpu.models.medusa import load_medusa
 
         draft_head = load_medusa(args.draft_head)
     batcher = ContinuousBatcher(

@@ -120,3 +120,21 @@ def medusa_loss(
         losses.append(jnp.where(valid, ce, 0.0).sum() / n)
     per_head = jnp.stack(losses)
     return per_head.sum(), per_head
+
+
+def save_medusa(path: str, medusa: MedusaParams) -> None:
+    """Head-stack npz IO lives HERE (not train/medusa.py) so inference
+    entry points can load heads without importing the optax/training
+    stack."""
+    import numpy as np
+
+    np.savez(path, w=np.asarray(medusa["w"]))
+
+
+def load_medusa(path: str, dtype=None) -> MedusaParams:
+    import numpy as np
+
+    with np.load(path) as z:
+        w = z["w"]
+    arr = jnp.asarray(w) if dtype is None else jnp.asarray(w, dtype)
+    return {"w": arr}

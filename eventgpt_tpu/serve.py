@@ -168,7 +168,10 @@ def _spec_segment(
     overshoot — the row may be harvested right after this segment), and a
     row is ``done`` only when its EOS lands within that cap.
 
-    Returns (ids_buf, n_new (B,), done (B,), cache, key, drafts).
+    Returns (ids_buf, n_new (B,), done (B,), cache, key, drafts,
+    n_iters_run) — the last is the executed iteration count, so the
+    server can report REALIZED acceptance (committed tokens per verify
+    iteration) on live traffic instead of inferring it.
     """
     from eventgpt_tpu.models.eventchat import _spec_draft_verify
 
@@ -211,12 +214,12 @@ def _spec_segment(
         cache = {**cache, "length": cache["length"] + m_eff}
         return it + 1, ids_buf, n_new, done, cache, key, drafts
 
-    _, ids_buf, n_new, done, cache, key, drafts = lax.while_loop(
+    it, ids_buf, n_new, done, cache, key, drafts = lax.while_loop(
         cond, body,
         (jnp.int32(0), ids_buf, jnp.zeros((b,), jnp.int32),
          jnp.zeros((b,), bool), cache, key, drafts),
     )
-    return ids_buf, n_new, done, cache, key, drafts
+    return ids_buf, n_new, done, cache, key, drafts, it
 
 
 _spec_segment_jit = functools.partial(
@@ -338,6 +341,9 @@ def _get_sharded_spec_segment(
     flat_cache_sh, cache_treedef, ids_sh, b_sh, key_sh, drafts_sh,
 ):
     cache_sh = jax.tree_util.tree_unflatten(cache_treedef, list(flat_cache_sh))
+    scalar_sh = jax.sharding.NamedSharding(
+        key_sh.mesh, jax.sharding.PartitionSpec()
+    )
     return jax.jit(
         lambda params, cache, key, ids_buf, base_pos, frozen, n_rem, history,
         medusa, drafts:
@@ -347,7 +353,8 @@ def _get_sharded_spec_segment(
             history=history, medusa=medusa, drafts=drafts,
         ),
         donate_argnums=(1,),
-        out_shardings=(ids_sh, b_sh, b_sh, cache_sh, key_sh, drafts_sh),
+        out_shardings=(ids_sh, b_sh, b_sh, cache_sh, key_sh, drafts_sh,
+                       scalar_sh),
     )
 
 
@@ -545,6 +552,13 @@ class ContinuousBatcher:
         self.admission_s = 0.0
         self.admission_max_s = 0.0
         self.request_stats: Dict[int, Dict[str, float]] = {}
+        # Realized speculative acceptance on live traffic: committed
+        # tokens / verify iterations, AGGREGATE across batch rows (each
+        # iteration verifies every active row at the cost of one
+        # weight-streaming pass, so this is tokens-per-pass — it exceeds
+        # the per-chain window bound when several rows are active).
+        self.spec_iterations = 0
+        self.spec_tokens = 0
 
     def _init_mesh_placement(self, vocab: int) -> None:
         """Place the resident buffers on the serving mesh and record their
@@ -746,6 +760,22 @@ class ContinuousBatcher:
         out, self.finished = self.finished, {}
         return out
 
+    def spec_tokens_per_iteration(self) -> float:
+        """Realized aggregate acceptance: committed tokens per verify
+        iteration (= per weight-streaming pass, summed across batch rows
+        — exceeds the per-chain window bound when several rows are
+        active). THE definition; /stats and the bench both read it here."""
+        return self.spec_tokens / max(self.spec_iterations, 1)
+
+    def reset_serving_stats(self) -> None:
+        """Zero the phase-scoped counters (admission stalls, speculative
+        acceptance) — e.g. after warmup or an unmeasured first request,
+        so a measured window reports only its own traffic."""
+        self.admission_s = 0.0
+        self.admission_max_s = 0.0
+        self.spec_iterations = 0
+        self.spec_tokens = 0
+
     # -- scheduler core ---------------------------------------------------
 
     def step(self) -> None:
@@ -768,6 +798,8 @@ class ContinuousBatcher:
         tokens, new_np, n_new, done = self._segment(
             jnp.asarray(self.frozen), jnp.asarray(self.n_rem.astype(np.int32))
         )
+        if self.speculative:
+            self.spec_tokens += int(n_new.sum())
         now = time.perf_counter()
         for r, req in enumerate(self.rows):
             if req is None or self.frozen[r]:
@@ -807,14 +839,14 @@ class ContinuousBatcher:
                     self._drafts_sh,
                 )
                 (self.ids_buf, n_new, done, self.cache, self.key,
-                 self.spec_drafts) = fn(
+                 self.spec_drafts, it) = fn(
                     self.params, self.cache, self.key, self.ids_buf,
                     base_pos, frozen, n_rem, history, self.draft_head,
                     self.spec_drafts,
                 )
             else:
                 (self.ids_buf, n_new, done, self.cache, self.key,
-                 self.spec_drafts) = (
+                 self.spec_drafts, it) = (
                     _spec_segment_jit(
                         self.params, self.cfg, self.cache, self.key,
                         self.ids_buf, base_pos,
@@ -831,6 +863,10 @@ class ContinuousBatcher:
             new_np = np.asarray(jax.device_get(
                 _gather_new_jit(self.ids_buf, base_pos, width)
             ))
+            # After the gather's device_get (which already synchronized):
+            # reading `it` first would stall the gather dispatch by one
+            # tunnel round trip per step.
+            self.spec_iterations += int(jax.device_get(it))
             tokens = None
         else:
             if self.mesh is not None:

@@ -102,16 +102,6 @@ def init_medusa_state(
     )
 
 
-def save_medusa(path: str, medusa: Any) -> None:
-    import numpy as np
-
-    np.savez(path, w=np.asarray(medusa["w"]))
-
-
-def load_medusa(path: str, dtype=None):
-    import numpy as np
-
-    with np.load(path) as z:
-        w = z["w"]
-    arr = jnp.asarray(w) if dtype is None else jnp.asarray(w, dtype)
-    return {"w": arr}
+# npz IO lives with the model (models/medusa.py: inference entry points
+# must not pull optax); re-exported here for training-side callers.
+from eventgpt_tpu.models.medusa import load_medusa, save_medusa  # noqa: E402,F401

@@ -84,7 +84,7 @@ def main(argv=None):
 
     draft_head = None
     if args.draft_head:
-        from eventgpt_tpu.train.medusa import load_medusa
+        from eventgpt_tpu.models.medusa import load_medusa
 
         draft_head = load_medusa(args.draft_head)
     srv = ContinuousBatcher(

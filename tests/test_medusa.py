@@ -269,7 +269,7 @@ def test_train_medusa_cli_end_to_end(tmp_path, tiny):
     assert os.path.exists(out)
     assert np.isfinite(last["loss"])
 
-    from eventgpt_tpu.train.medusa import load_medusa
+    from eventgpt_tpu.models.medusa import load_medusa
 
     cfg, params = tiny  # NOTE: different weights than the CLI's loader —
     # exactness holds for ANY heads, which is exactly the contract.
@@ -283,7 +283,7 @@ def test_train_medusa_cli_end_to_end(tmp_path, tiny):
 
 
 def test_medusa_save_load_roundtrip(tmp_path, tiny):
-    from eventgpt_tpu.train.medusa import load_medusa, save_medusa
+    from eventgpt_tpu.models.medusa import load_medusa, save_medusa
 
     cfg, params = tiny
     medusa = _random_heads(cfg, 3)
