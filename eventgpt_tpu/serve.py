@@ -545,20 +545,15 @@ class ContinuousBatcher:
         self._next_rid = 0
         self.prefill_chunk = int(prefill_chunk)
         self._pending: Optional[_PendingAdmission] = None
-        # Service metrics: wall time spent inside _admit (total, and the
-        # worst single scheduling iteration — the stall bound chunked
-        # prefill exists to cut) and per-request TTFT / completion
-        # latency, keyed by rid.
-        self.admission_s = 0.0
-        self.admission_max_s = 0.0
+        # Service metrics: per-request TTFT / completion latency keyed by
+        # rid, plus the phase-scoped counters reset_serving_stats() owns
+        # (admission stall totals/max — the bound chunked prefill exists
+        # to cut — and realized speculative acceptance: committed tokens
+        # per verify iteration, AGGREGATE across batch rows = tokens per
+        # weight-streaming pass, so it exceeds the per-chain window bound
+        # when several rows are active).
         self.request_stats: Dict[int, Dict[str, float]] = {}
-        # Realized speculative acceptance on live traffic: committed
-        # tokens / verify iterations, AGGREGATE across batch rows (each
-        # iteration verifies every active row at the cost of one
-        # weight-streaming pass, so this is tokens-per-pass — it exceeds
-        # the per-chain window bound when several rows are active).
-        self.spec_iterations = 0
-        self.spec_tokens = 0
+        self.reset_serving_stats()
 
     def _init_mesh_placement(self, vocab: int) -> None:
         """Place the resident buffers on the serving mesh and record their
@@ -858,15 +853,16 @@ class ContinuousBatcher:
                 )
             # Read back only the window a segment could have written
             # (n_iters * window <= max(chunk, window) slots per row), not
-            # the whole (B, max_len) buffer.
+            # the whole (B, max_len) buffer — and everything the host
+            # needs in ONE device_get (each transfer is its own round
+            # trip through the tunnel).
             width = max(self.chunk, self.speculative)
-            new_np = np.asarray(jax.device_get(
-                _gather_new_jit(self.ids_buf, base_pos, width)
-            ))
-            # After the gather's device_get (which already synchronized):
-            # reading `it` first would stall the gather dispatch by one
-            # tunnel round trip per step.
-            self.spec_iterations += int(jax.device_get(it))
+            new_np, it_v, n_new, done = jax.device_get(
+                (_gather_new_jit(self.ids_buf, base_pos, width),
+                 it, n_new, done)
+            )
+            self.spec_iterations += int(it_v)
+            new_np = np.asarray(new_np)
             tokens = None
         else:
             if self.mesh is not None:
@@ -888,11 +884,10 @@ class ContinuousBatcher:
                         self.temperature, self.top_p,
                     )
                 )
-            tokens = np.asarray(jax.device_get(tokens))
+            tokens, n_new, done = jax.device_get((tokens, n_new, done))
+            tokens = np.asarray(tokens)
             new_np = None
-        n_new = np.asarray(jax.device_get(n_new))
-        done = np.asarray(jax.device_get(done))
-        return tokens, new_np, n_new, done
+        return tokens, new_np, np.asarray(n_new), np.asarray(done)
 
     def _finish_row(self, r: int) -> None:
         import time
