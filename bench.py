@@ -509,6 +509,55 @@ def run_warm_probe(args):
     return record
 
 
+# TPU v5e bf16 matmul peak (the chip PERFORMANCE.md's rooflines use);
+# int8-weight training still runs its MXU passes in bf16 after dequant.
+_V5E_PEAK_BF16_FLOPS = 197e12
+
+
+def _train_flops_per_step(cfg, batch: int, seq: int) -> dict:
+    """Analytic model FLOPs for one stage-2 step (multiply-add = 2).
+
+    Decomposition (what actually runs, not 6ND folklore):
+      * LLaMA matmuls fwd: 2 * n_mm * tokens.
+      * LLaMA attention fwd: scores + AV, causal-halved:
+        2 * L * seq^2 * q_dim per sample.
+      * backward: dgrad through every frozen LLaMA matmul is required for
+        LoRA (chain rule through the base), and dgrad is exactly ONE
+        matmul of equal cost (dX = dY @ W^T) — wgrad exists only for the
+        LoRA/projector leaves (negligible). So matmul bwd ~ 1x fwd, NOT
+        the full-training 2x. Attention bwd needs dV, dA, dQ, dK — four
+        matmuls vs the forward's two -> attention bwd = 2x attention fwd.
+      * CLIP tower: forward only — stage 2 takes no gradient through it
+        (the projector is the first trainable node on that path).
+      * remat recompute is NOT counted (standard MFU counts model FLOPs;
+        the recompute shows up as lower MFU, which is the point).
+    """
+    lc = cfg.llama
+    hd = lc.resolved_head_dim()
+    q_dim = lc.num_heads * hd
+    kv_dim = lc.num_kv_heads * hd
+    n_mm = lc.num_layers * (
+        lc.hidden_size * q_dim + 2 * lc.hidden_size * kv_dim
+        + q_dim * lc.hidden_size + 3 * lc.hidden_size * lc.intermediate_size
+    ) + lc.hidden_size * lc.vocab_size  # lm_head; embed is a gather
+    tokens = batch * seq
+    llama_mm_fwd = 2.0 * n_mm * tokens
+    llama_attn_fwd = 2.0 * lc.num_layers * seq * seq * q_dim * batch / 2.0 * 2.0
+    # (scores + AV = 2 matmuls) * causal 1/2 — written out so the factors
+    # are auditable: 2 FLOP/MAC * 2 matmuls * 1/2 causal = 2.
+    vc = cfg.vision
+    clip_tokens = batch * cfg.num_event_frames * (
+        (vc.image_size // vc.patch_size) ** 2 + 1)
+    n_clip = vc.num_layers * (4 * vc.hidden_size ** 2
+                              + 2 * vc.hidden_size * vc.intermediate_size)
+    clip_fwd = 2.0 * n_clip * clip_tokens
+    llama_fwd = llama_mm_fwd + llama_attn_fwd
+    # fwd + dgrad-only matmul bwd (1x) + attention bwd (2x attn fwd):
+    total = 2.0 * llama_mm_fwd + 3.0 * llama_attn_fwd + clip_fwd
+    return {"total": total, "llama_fwd": llama_fwd, "clip_fwd": clip_fwd,
+            "n_llama_mm_params": n_mm}
+
+
 def run_train(args):
     import jax
     import jax.numpy as jnp
@@ -519,6 +568,12 @@ def run_train(args):
     from eventgpt_tpu.train.optim import linear_warmup_cosine, make_optimizer
 
     preset, cfg, platform = _resolve_preset(args)
+    if args.remat != "default":
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg, llama=dataclasses.replace(cfg.llama,
+                                           remat=args.remat == "on"))
     dtype = jnp.bfloat16
 
     # QLoRA-style stage 2 by default at 7B: int8 frozen base + apply-form
@@ -559,6 +614,7 @@ def run_train(args):
     dt = (time.perf_counter() - t0) / args.steps
 
     tokens_per_step = int(host["attn_mask"].sum())
+    flops = _train_flops_per_step(cfg, b, seq)
     record = {
         "metric": f"stage2_step_time_{preset}",
         "value": round(dt, 4),
@@ -567,11 +623,55 @@ def run_train(args):
         "seq": seq,
         "lora_r": args.lora_r,
         "quant": quant,
+        "remat": cfg.llama.remat,
         "tokens_per_s": round(tokens_per_step / dt, 1),
+        "model_tflops_per_step": round(flops["total"] / 1e12, 2),
         "loss_finite": bool(np.isfinite(float(_sync(metrics["loss"])))),
         "platform": platform,
     }
+    if platform == "tpu":
+        record["mfu"] = round(flops["total"] / dt / _V5E_PEAK_BF16_FLOPS, 4)
     return _emit(record, "train", dt)
+
+
+def run_train_sweep(args):
+    """Stage-2 step time over batch x seq x remat (VERDICT r4 #3): each
+    point is a fresh subprocess (clean HBM; OOM at one point must not
+    poison the next), recorded honestly including OOM entries. Emits ONE
+    JSON line with the grid and the best throughput config."""
+    points = []
+    best = None
+    for remat in ("on", "off"):
+        for seq in (704, 1408):
+            for batch in (1, 2, 4, 8):
+                leg_args = ["--mode", "train", "--preset", args.preset,
+                            "--quant", args.quant, "--steps", str(args.steps),
+                            "--seq", str(seq), "--batch", str(batch),
+                            "--lora_r", str(args.lora_r), "--remat", remat]
+                try:
+                    r = _leg(leg_args, timeout=2400)
+                    pt = {"batch": batch, "seq": seq, "remat": remat == "on",
+                          "step_s": r["value"],
+                          "tokens_per_s": r["tokens_per_s"],
+                          "mfu": r.get("mfu")}
+                    if best is None or pt["tokens_per_s"] > best["tokens_per_s"]:
+                        best = pt
+                except Exception as e:
+                    msg = str(e)[-200:]
+                    pt = {"batch": batch, "seq": seq, "remat": remat == "on",
+                          "oom_or_error": msg}
+                points.append(pt)
+                sys.stderr.write(f"train_sweep point {pt}\n")
+    record = {
+        "metric": f"stage2_train_sweep_{args.preset}",
+        "value": best["tokens_per_s"] if best else 0.0,
+        "unit": "tok/s",
+        "vs_baseline": 1.0,
+        "best": best,
+        "grid": points,
+    }
+    print(json.dumps(record))
+    return record
 
 
 def _leg(extra_args, timeout=3600):
@@ -684,8 +784,8 @@ def run_all(args):
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--mode", default="all",
-                   choices=["all", "decode", "train", "warm_probe", "spec",
-                            "serve"])
+                   choices=["all", "decode", "train", "train_sweep",
+                            "warm_probe", "spec", "serve"])
     p.add_argument("--spec_window", type=int, default=8,
                    help="speculative verify window (mode=spec)")
     p.add_argument("--serve_requests", type=int, default=8,
@@ -713,6 +813,9 @@ def main() -> None:
     p.add_argument("--seq", type=int, default=704)
     p.add_argument("--steps", type=int, default=4)
     p.add_argument("--lora_r", type=int, default=16)
+    p.add_argument("--remat", default="default", choices=["default", "on", "off"],
+                   help="override cfg.llama.remat for mode=train (default: "
+                        "the config's value, True at 7B)")
     p.add_argument("--warmup", type=int, default=0,
                    help="mode=serve: precompile every executable via "
                         "ContinuousBatcher.warmup() before serving")
@@ -723,6 +826,9 @@ def main() -> None:
         # holding a live TPU client would undercut the per-leg HBM isolation
         # (each leg enables the cache itself).
         run_all(args)
+        return
+    if args.mode == "train_sweep":
+        run_train_sweep(args)  # subprocess orchestrator, like run_all
         return
 
     from eventgpt_tpu.utils.compile_cache import enable_compile_cache

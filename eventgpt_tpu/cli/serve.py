@@ -69,6 +69,7 @@ class ServingEngine:
         self._answers: Dict[int, list] = {}
         self._streams: Dict[int, queue.Queue] = {}
         self._sent: Dict[int, int] = {}
+        self._abandoned: set = set()  # timed-out rids: drop at harvest
         self.n_requests = 0
         self.t_start = time.time()
         self.fault: Any = None  # repr of a scheduler-thread death, if any
@@ -111,6 +112,16 @@ class ServingEngine:
         """Block until the request finishes; returns its token ids."""
         ev = self._done[rid]
         if not ev.wait(timeout):
+            with self._lock:
+                # The batcher will still finish this request; with its
+                # waiter gone the answer would sit in _answers forever
+                # (unbounded host growth on a long-lived server). Either
+                # take the answer that landed in the race window, or mark
+                # the rid for drop-at-harvest like an orphaned stream.
+                self._done.pop(rid, None)
+                if rid in self._answers:
+                    return self._answers.pop(rid)
+                self._abandoned.add(rid)
             raise TimeoutError(f"request {rid} did not finish in {timeout}s")
         with self._lock:
             self._done.pop(rid, None)
@@ -197,6 +208,7 @@ class ServingEngine:
             self._sent.clear()
             for ev in self._done.values():
                 ev.set()  # result() sees no answer -> raises the fault
+            self._abandoned.clear()
             self.batcher.queue.clear()
 
     def _push_stream_deltas(self) -> None:
@@ -213,6 +225,11 @@ class ServingEngine:
             return
         done, self.batcher.finished = self.batcher.finished, {}
         for rid, toks in done.items():
+            if rid in self._abandoned:
+                # Its waiter timed out and went away; keeping the answer
+                # would leak it (result() registered the drop).
+                self._abandoned.discard(rid)
+                continue
             if rid in self._streams:
                 # Stream consumers hold their own queue reference; drop
                 # ALL engine-side state here — a streamed request never
@@ -233,27 +250,16 @@ class ServingEngine:
 def _decode_pixels(payload: Dict[str, Any], cfg, event_root=None):
     """event_path (confined under --event_root) or event_b64 (inline npy)
     -> pixel frames."""
-    import os
-
     from eventgpt_tpu.ops.image import process_event_file
+    from eventgpt_tpu.utils.paths import resolve_event_path
 
     if "event_path" in payload:
         # Network-facing file access is allowlisted by directory: without
         # --event_root, server-local paths are disabled outright (clients
         # upload via event_b64); with it, the resolved path must stay
-        # inside the root — no probing the server's filesystem.
-        if event_root is None:
-            raise ValueError(
-                "event_path is disabled (start the server with "
-                "--event_root DIR to serve files under DIR, or send the "
-                "stream inline via event_b64)"
-            )
-        root = os.path.realpath(event_root)
-        path = os.path.realpath(
-            os.path.join(root, str(payload["event_path"]).lstrip("/"))
-        )
-        if path != root and not path.startswith(root + os.sep):
-            raise ValueError("event_path escapes --event_root")
+        # inside the root — no probing the server's filesystem. The
+        # confinement logic is shared with scripts/serve_demo.py.
+        path = resolve_event_path(event_root, payload["event_path"])
         try:
             _, pixels = process_event_file(
                 path, cfg.num_event_frames, cfg.vision.image_size
@@ -281,7 +287,8 @@ def _decode_pixels(payload: Dict[str, Any], cfg, event_root=None):
 
 
 def make_handler(engine: ServingEngine, cfg, event_root=None,
-                 default_budget: int = 64):
+                 default_budget: int = 64,
+                 max_body_bytes: int = 32 * 1024 * 1024):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -317,6 +324,25 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                 return
             try:
                 n = int(self.headers.get("Content-Length", "0"))
+            except ValueError:
+                # Rejecting without reading the body desynchronizes
+                # HTTP/1.1 keep-alive framing (unread body bytes would be
+                # parsed as the next request line) — close the connection.
+                self.close_connection = True
+                self._json(400, {"error": "bad Content-Length"})
+                return
+            if n > max_body_bytes:
+                # Reject BEFORE reading: Content-Length is attacker-
+                # controlled, and decoding an arbitrarily large event_b64
+                # would let any client that reaches the port allocate
+                # unbounded host memory per request.
+                self.close_connection = True  # unread body: see above
+                self._json(413, {"error":
+                                 f"body {n} bytes exceeds the "
+                                 f"{max_body_bytes}-byte limit "
+                                 f"(--max_body_mb)"})
+                return
+            try:
                 payload = json.loads(self.rfile.read(n) or b"{}")
                 query = payload["query"]
                 budget = int(payload.get("max_new_tokens", default_budget))
@@ -332,6 +358,12 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                 # submit()'s own validation (budget does not fit max_len,
                 # malformed sentinel count) is still the client's fault.
                 self._json(400, {"error": str(e)})
+                return
+            except RuntimeError as e:
+                # Engine faulted (scheduler thread died): surface the loud
+                # 503 /health already advertises instead of letting this
+                # handler thread throw and drop the connection.
+                self._json(503, {"error": str(e)})
                 return
             if stream:
                 try:
@@ -366,7 +398,11 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
             cumulative decode — emitted eagerly it would corrupt the
             stream (a chunked body cannot retract bytes). Stripped tails
             that never resolve (genuinely invalid bytes) flush in the
-            terminal delta, so concat(deltas) == the final answer."""
+            terminal delta. When a longer decode REWRITES earlier text
+            (sentencepiece whitespace/detokenization effects make the
+            cumulative decode non-prefix-stable), a corrective
+            ``{"restart": full_text}`` event replaces the client's buffer
+            — so apply(deltas ∘ restarts) == the final answer always."""
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
@@ -377,8 +413,19 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                 self.wfile.write(f"{len(line):x}\r\n".encode())
                 self.wfile.write(line + b"\r\n")
 
-            q = engine.stream_queue(rid)
             sent = ""
+
+            def emit(new_text: str) -> None:
+                nonlocal sent
+                if new_text == sent:
+                    return
+                if new_text.startswith(sent):
+                    chunk({"delta": new_text[len(sent):], "rid": rid})
+                else:
+                    chunk({"restart": new_text, "rid": rid})
+                sent = new_text
+
+            q = engine.stream_queue(rid)
             text = ""
             while True:
                 toks = q.get()
@@ -393,13 +440,8 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                 text = engine.tokenizer.batch_decode(
                     [toks], skip_special_tokens=True
                 )[0]
-                stable = text.rstrip("�")
-                if stable.startswith(sent) and len(stable) > len(sent):
-                    chunk({"delta": stable[len(sent):], "rid": rid})
-                    sent = stable
-            if text.startswith(sent) and len(text) > len(sent):
-                chunk({"delta": text[len(sent):], "rid": rid})
-                sent = text
+                emit(text.rstrip("�"))
+            emit(text)  # flush any held-back tail, rewritten or not
             chunk({"done": True, "rid": rid, "answer": sent.strip()})
             self.wfile.write(b"0\r\n\r\n")
 
@@ -445,7 +487,9 @@ def build_server(args) -> tuple:
     httpd = ThreadingHTTPServer(
         (args.host, args.port),
         make_handler(engine, cfg, getattr(args, "event_root", None),
-                     default_budget=getattr(args, "max_new_tokens", 64)),
+                     default_budget=getattr(args, "max_new_tokens", 64),
+                     max_body_bytes=int(
+                         getattr(args, "max_body_mb", 32) * 1024 * 1024)),
     )
     return httpd, engine
 
@@ -461,6 +505,9 @@ def main(argv=None):
                         "unset = server-local paths disabled (event_b64 "
                         "only)")
     p.add_argument("--conv_mode", default="eventgpt_v1")
+    p.add_argument("--max_body_mb", type=float, default=32.0,
+                   help="largest accepted POST body (413 above this); size "
+                        "for the biggest event_b64 upload you expect")
     p.add_argument("--max_batch", type=int, default=4)
     p.add_argument("--max_len", type=int, default=1024)
     p.add_argument("--chunk", type=int, default=128)

@@ -70,15 +70,19 @@ def tracks_to_dataset(
     One sample per tracked frame interval with >= ``min_tracks``
     surviving tracks: the interval's event window is the visual input,
     the question asks for the dominant motion, the answer states the
-    compass direction (or "mostly still" below ``min_speed`` when
-    ``still_speed`` is not given). Returns the number of samples written.
+    compass direction. The two speed knobs are independent (ADVICE r4 —
+    they were previously conflated): ``still_speed``, when given, labels
+    intervals below it "mostly still" (a trainable negative class);
+    ``min_speed`` then DROPS intervals below it that were not claimed as
+    still — too slow for a direction label, too fast for a still one.
+    With the default ``still_speed=None`` slow intervals are simply
+    filtered. Returns the number of samples written.
     """
     rows = load_tracks_csv(csv_path)
     by_frame: Dict[int, List[Dict[str, float]]] = {}
     for r in rows:
         by_frame.setdefault(int(r["frame"]), []).append(r)
 
-    still = min_speed if still_speed is None else still_speed
     entries = []
     for frame in sorted(by_frame):
         rows_f = by_frame[frame]
@@ -88,9 +92,11 @@ def tracks_to_dataset(
         if not os.path.exists(os.path.join(events_dir, npy)):
             continue
         direction, speed, n = dominant_motion(rows_f)
-        if speed < still:
+        if still_speed is not None and speed < still_speed:
             answer = ("The scene is mostly still; the tracked features "
                       "barely move between frames.")
+        elif speed < min_speed:
+            continue
         else:
             answer = (f"The dominant motion is toward the {direction}, "
                       f"at about {speed:.1f} pixels per frame across "
@@ -121,11 +127,16 @@ def main(argv=None):
     p.add_argument("events_dir")
     p.add_argument("out_json")
     p.add_argument("--min_tracks", type=int, default=3)
-    p.add_argument("--min_speed", type=float, default=0.5)
+    p.add_argument("--min_speed", type=float, default=0.5,
+                   help="drop intervals slower than this (px/frame)")
+    p.add_argument("--still_speed", type=float, default=None,
+                   help="label intervals below this 'mostly still' "
+                        "instead of dropping them")
     args = p.parse_args(argv)
     n = tracks_to_dataset(args.tracks_csv, args.events_dir, args.out_json,
                           min_tracks=args.min_tracks,
-                          min_speed=args.min_speed)
+                          min_speed=args.min_speed,
+                          still_speed=args.still_speed)
     print(f"wrote {n} samples to {args.out_json}")
     return n
 

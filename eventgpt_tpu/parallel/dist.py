@@ -29,6 +29,15 @@ log = logging.getLogger("eventgpt_tpu.dist")
 
 _INITIALIZED = False
 
+# Presence of any of these means a cloud/pod launcher will feed
+# jax.distributed.initialize its coordination parameters. Exported so test
+# harnesses that simulate standalone hosts scrub exactly this set
+# (parallel/multiproc.py) — a private copy would drift.
+POD_AUTODETECT_VARS = (
+    "TPU_WORKER_HOSTNAMES", "TPU_SKYLARK_HOSTS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+)
+
 
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
@@ -53,10 +62,7 @@ def initialize_distributed(
         process_id = int(os.environ["EGPT_PROCESS_ID"])
 
     explicit = coordinator_address is not None
-    autodetectable = any(
-        v in os.environ
-        for v in ("TPU_WORKER_HOSTNAMES", "TPU_SKYLARK_HOSTS", "MEGASCALE_COORDINATOR_ADDRESS")
-    )
+    autodetectable = any(v in os.environ for v in POD_AUTODETECT_VARS)
     if not explicit and not autodetectable:
         if num_processes is not None or process_id is not None:
             # Half-configured launch: running on silently would give N

@@ -22,7 +22,14 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--model_path", default="tiny-random")
     p.add_argument("--tokenizer_path", default=None)
-    p.add_argument("--event_frame", required=True)
+    p.add_argument("--event_frame", required=True,
+                   help="event .npy to answer about; with --event_root, a "
+                        "path relative to (and confined under) that root")
+    p.add_argument("--event_root", default=None,
+                   help="optional allowlist root: --event_frame must "
+                        "resolve inside it (same confinement as "
+                        "cli/serve.py — set this when the frame name "
+                        "comes from anything other than your own shell)")
     p.add_argument("--queries", required=True,
                    help="';'-separated natural-language questions")
     p.add_argument("--conv_mode", default="eventgpt_v1")
@@ -59,6 +66,13 @@ def main(argv=None):
     p.add_argument("--pretrain_attention_layers", default=None)
     args = p.parse_args(argv)
 
+    frame = args.event_frame
+    if args.event_root is not None:
+        # Fail before touching the model: same confinement as cli/serve.py.
+        from eventgpt_tpu.utils.paths import resolve_event_path
+
+        frame = resolve_event_path(args.event_root, frame)
+
     from eventgpt_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
@@ -80,7 +94,7 @@ def main(argv=None):
     mesh = build_serving_mesh(args.mesh_data, args.mesh_fsdp, args.mesh_model)
     cfg, params = prepare_model(cfg, params, tokenizer, args, mesh=mesh)
     _, pixels = process_event_file(
-        args.event_frame, cfg.num_event_frames, cfg.vision.image_size
+        frame, cfg.num_event_frames, cfg.vision.image_size
     )
 
     draft_head = None

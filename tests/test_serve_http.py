@@ -211,6 +211,181 @@ def test_engine_fault_is_loud():
         engine.shutdown()
 
 
+def test_oversized_body_is_413(server):
+    """Content-Length beyond --max_body_mb is rejected BEFORE the body is
+    read — a reachable port must not buy arbitrary host allocations."""
+    import http.client
+
+    url, _ = server
+    host, port = url.replace("http://", "").split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    try:
+        conn.putrequest("POST", "/v1/generate")
+        conn.putheader("Content-Type", "application/json")
+        # Claim a 10 GB body; send none. The server must answer from the
+        # header alone.
+        conn.putheader("Content-Length", str(10 * 1024 ** 3))
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 413
+        assert b"max_body_mb" in resp.read()
+    finally:
+        conn.close()
+
+
+def test_result_timeout_releases_state(server):
+    """A waiter that times out must not leak the eventual answer into
+    _answers forever (ADVICE r4: unbounded host growth past 600 s)."""
+    import time as _time
+
+    url, engine = server
+    from eventgpt_tpu.ops.image import process_event_file
+
+    bcfg = engine.batcher.cfg
+    _, pixels = process_event_file(
+        SAMPLE, bcfg.num_event_frames, bcfg.vision.image_size)
+    rid = engine.submit("leak check?", pixels, 4)
+    with pytest.raises(TimeoutError):
+        engine.result(rid, timeout=0.0)
+    # Let the batcher finish the request, then the harvest must drop it.
+    deadline = _time.time() + 120
+    while _time.time() < deadline:
+        s = engine.stats()
+        if s["active_rows"] == 0 and s["queued"] == 0 \
+                and rid not in engine._abandoned:
+            break
+        _time.sleep(0.2)
+    assert rid not in engine._answers
+    assert rid not in engine._done
+    assert rid not in engine._abandoned
+
+
+def test_faulted_engine_returns_503():
+    """submit() on a faulted engine surfaces as HTTP 503 with the fault,
+    not a dropped connection (ADVICE r4: do_POST only caught ValueError)."""
+    import base64 as b64mod
+    import urllib.error
+    import urllib.request as urlreq
+
+    import jax
+    import numpy as np
+
+    from eventgpt_tpu.cli.serve import ServingEngine, make_handler
+    from eventgpt_tpu.config import EventChatConfig
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.models import eventchat
+    from eventgpt_tpu.serve import ContinuousBatcher
+    from http.server import ThreadingHTTPServer
+
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    srv = ContinuousBatcher(params, cfg, max_batch=1, max_len=256, chunk=4,
+                            eos_token_id=None)
+
+    def boom():
+        raise RuntimeError("boom")
+
+    srv.step = boom
+    engine = ServingEngine(srv, load_tokenizer("byte"))
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0),
+        make_handler(engine, cfg, os.path.dirname(SAMPLE)))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        rng = np.random.default_rng(0)
+        pv = rng.normal(size=(cfg.num_event_frames, 3, cfg.vision.image_size,
+                              cfg.vision.image_size)).astype(np.float32)
+        rid = engine.submit("trigger?", pv, 4)
+        with pytest.raises(RuntimeError):
+            engine.result(rid, timeout=60)
+        assert engine.fault is not None
+        req = urlreq.Request(
+            f"http://127.0.0.1:{httpd.server_address[1]}/v1/generate",
+            json.dumps({"query": "x", "event_path": "sample1.npy"}).encode(),
+            {"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urlreq.urlopen(req, timeout=60)
+        assert e.value.code == 503
+        assert "boom" in json.loads(e.value.read())["error"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        engine.shutdown()
+
+
+def test_stream_restart_event_on_detokenizer_rewrite():
+    """When a longer cumulative decode REWRITES earlier text (sentencepiece
+    whitespace effects), the stream must emit a corrective {"restart"}
+    event rather than silently dropping deltas (ADVICE r4)."""
+    import urllib.request as urlreq
+
+    import jax
+    import numpy as np
+
+    from eventgpt_tpu.cli.serve import ServingEngine, make_handler
+    from eventgpt_tpu.config import EventChatConfig
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.models import eventchat
+    from eventgpt_tpu.serve import ContinuousBatcher
+    from http.server import ThreadingHTTPServer
+
+    base = load_tokenizer("byte")
+
+    class RewritingTokenizer:
+        """batch_decode is NOT prefix-stable: past 6 tokens it upcases the
+        first word — modelling sentencepiece re-merging earlier text."""
+
+        eos_token_id = getattr(base, "eos_token_id", None)
+
+        def __getattr__(self, name):
+            return getattr(base, name)
+
+        def __call__(self, *a, **kw):  # dunders bypass __getattr__
+            return base(*a, **kw)
+
+        def batch_decode(self, seqs, **kw):
+            out = base.batch_decode(seqs, **kw)
+            return [t.upper() if len(seqs[0]) > 6 else t for t in out]
+
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    srv = ContinuousBatcher(params, cfg, max_batch=1, max_len=256, chunk=2,
+                            eos_token_id=None)
+    tok = RewritingTokenizer()
+    engine = ServingEngine(srv, tok)
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0), make_handler(engine, cfg, os.path.dirname(SAMPLE)))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        req = urlreq.Request(
+            f"http://127.0.0.1:{httpd.server_address[1]}/v1/generate",
+            json.dumps({"query": "What moves?", "event_path": "sample1.npy",
+                        "max_new_tokens": 10, "stream": True}).encode(),
+            {"Content-Type": "application/json"})
+        buf, restarts, final = "", 0, None
+        with urlreq.urlopen(req, timeout=300) as r:
+            for line in r:
+                obj = json.loads(line)
+                if obj.get("done"):
+                    final = obj["answer"]
+                elif "restart" in obj:
+                    restarts += 1
+                    buf = obj["restart"]
+                elif "delta" in obj:
+                    buf += obj["delta"]
+        assert final is not None
+        assert restarts >= 1  # the rewrite at token 7 must be corrected
+        assert buf.strip() == final  # applied stream == terminal answer
+        # and the terminal answer equals a direct decode of the tokens
+        assert final == final.upper()  # rewrite took effect
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        engine.shutdown()
+
+
 def test_warmup_after_admission_raises(server):
     """The batcher's warmup precondition: never on live rows."""
     _, engine = server
