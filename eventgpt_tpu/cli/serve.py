@@ -324,6 +324,10 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                 return
             try:
                 n = int(self.headers.get("Content-Length", "0"))
+                if n < 0:
+                    # read(-1) would block until client EOF, pinning this
+                    # handler thread forever.
+                    raise ValueError
             except ValueError:
                 # Rejecting without reading the body desynchronizes
                 # HTTP/1.1 keep-alive framing (unread body bytes would be
@@ -477,6 +481,7 @@ def build_server(args) -> tuple:
         kv_quant=args.kv_cache == "int8", speculative=args.speculative,
         mesh=mesh, prefill_chunk=args.prefill_chunk,
         draft_head=draft_head,
+        first_chunk=getattr(args, "first_chunk", 0),
     )
     if args.warmup:
         t0 = time.perf_counter()
@@ -525,6 +530,10 @@ def main(argv=None):
                    help="trained Medusa head stack (.npz) for speculative "
                         "drafting (requires --speculative > 0)")
     p.add_argument("--prefill_chunk", type=int, default=0)
+    p.add_argument("--first_chunk", type=int, default=0,
+                   help="TTFT ramp: short segment length while a fresh "
+                        "admission owes its first token (0 = off; "
+                        "PERFORMANCE.md serving section for the tradeoff)")
     p.add_argument("--warmup", action="store_true")
     p.add_argument("--mesh_data", type=int, default=1)
     p.add_argument("--mesh_fsdp", type=int, default=1)

@@ -124,6 +124,36 @@ def load_event_npy(path: str) -> EventDict:
     )
 
 
+# The native threaded reader's on-disk layout (shared with the C++
+# SaveEventsNpy writer, native/src/events_io.cpp): one struct per event.
+STREAM_DTYPE = np.dtype([("x", "<u2"), ("y", "<u2"),
+                         ("t", "<u8"), ("p", "u1")])
+
+
+def events_to_structured_stream(events: EventDict) -> np.ndarray:
+    """{x,y,t,p} dict -> the native reader's structured-array layout.
+
+    The reference's samples are pickled dicts the native reader
+    deliberately does not parse; this is the conversion every harness
+    (``scripts/stream_demo.py``, ``bench.py --mode stream``) uses to
+    replay them through ``native.EventStream``.
+    """
+    n = len(events["t"])
+    arr = np.zeros(n, dtype=STREAM_DTYPE)
+    for k in ("x", "y", "t", "p"):
+        arr[k] = events[k]
+    return arr
+
+
+def events_window_us(buf: Dict[str, np.ndarray], sel: np.ndarray) -> EventDict:
+    """Select a window from a float-seconds event dict, converting ``t``
+    to int64 microseconds — the ``events_to_frames`` contract both
+    streaming harnesses feed."""
+    return {k: (buf[k][sel] if k != "t"
+                else (buf["t"][sel] * 1e6).astype(np.int64))
+            for k in buf}
+
+
 def rasterize_events(
     x: np.ndarray,
     y: np.ndarray,

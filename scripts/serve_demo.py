@@ -56,6 +56,9 @@ def main(argv=None):
     p.add_argument("--prefill_chunk", type=int, default=0,
                    help="decode-interleaved admission prefill chunk "
                         "(0 = one-shot admission prefill)")
+    p.add_argument("--first_chunk", type=int, default=0,
+                   help="TTFT ramp: short segment while a fresh admission "
+                        "owes its first token (0 = off)")
     p.add_argument("--mesh_data", type=int, default=1)
     p.add_argument("--mesh_fsdp", type=int, default=1)
     p.add_argument("--mesh_model", type=int, default=1)
@@ -108,7 +111,7 @@ def main(argv=None):
         eos_token_id=getattr(tokenizer, "eos_token_id", None),
         kv_quant=args.kv_cache == "int8", speculative=args.speculative,
         mesh=mesh, prefill_chunk=args.prefill_chunk,
-        draft_head=draft_head,
+        draft_head=draft_head, first_chunk=args.first_chunk,
     )
     if args.warmup:
         t0 = time.perf_counter()

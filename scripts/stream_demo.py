@@ -34,17 +34,11 @@ SAMPLE = "/root/reference/samples/sample1.npy"
 
 def synthesize_stream(tmp_dir: str) -> str:
     """Reference sample (pickled dict) -> structured npy the native
-    streaming reader consumes."""
-    from eventgpt_tpu.ops.raster import load_event_npy
+    streaming reader consumes (shared layout helper in ops/raster)."""
+    from eventgpt_tpu.ops.raster import events_to_structured_stream, load_event_npy
 
-    events = load_event_npy(SAMPLE)
-    n = len(events["t"])
-    arr = np.zeros(n, dtype=[("x", "<u2"), ("y", "<u2"),
-                             ("t", "<u8"), ("p", "u1")])
-    for k in ("x", "y", "t", "p"):
-        arr[k] = events[k]
     path = os.path.join(tmp_dir, "stream_demo_events.npy")
-    np.save(path, arr)
+    np.save(path, events_to_structured_stream(load_event_npy(SAMPLE)))
     return path
 
 
@@ -83,7 +77,7 @@ def main(argv=None):
     from eventgpt_tpu.models import eventchat
     from eventgpt_tpu.native import EventStream, available
     from eventgpt_tpu.ops.image import clip_preprocess_batch
-    from eventgpt_tpu.ops.raster import events_to_frames
+    from eventgpt_tpu.ops.raster import events_to_frames, events_window_us
 
     if not available():
         sys.exit("libegpt_native.so not built; run scripts/build_native.sh")
@@ -131,11 +125,7 @@ def main(argv=None):
                    and answered < args.max_windows):
                 sel = (t_all >= cursor) & (t_all < cursor + window_s)
                 if sel.sum() >= cfg.num_event_frames:
-                    ev = {
-                        k: buf[k][sel] if k != "t"
-                        else (t_all[sel] * 1e6).astype(np.int64)
-                        for k in buf
-                    }
+                    ev = events_window_us(buf, sel)
                     t0 = time.perf_counter()
                     frames = events_to_frames(ev, cfg.num_event_frames)
                     pixels = clip_preprocess_batch(frames, cfg.vision.image_size)

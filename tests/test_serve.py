@@ -294,3 +294,104 @@ def test_speculative_server_acceptance_on_repetitive_chain(tiny):
     rid = srv.submit([1, 5, -200, 9], _pv(cfg, 0), 16)
     out = srv.run_until_drained()
     assert out[rid] == [0] * 16
+
+
+def test_first_chunk_ramp_equals_oneshot(tiny):
+    """The TTFT ramp (short segments while a fresh admission owes its
+    first token) is a pure scheduling change: greedy chains must equal
+    one-shot generate, including mid-flight admissions that re-trigger
+    the ramp, and warmup must precompile the ramp executable."""
+    cfg, params = tiny
+    reqs = [
+        ([1, 5, -200, 9, 9], _pv(cfg, 0), 12),
+        ([1, -200, 7, 7, 8, 14], _pv(cfg, 1), 9),
+        ([3, -200, 11], _pv(cfg, 2), 11),
+    ]
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=8,
+                            eos_token_id=None, first_chunk=2)
+    assert srv.first_chunk == 2
+    srv.warmup(prompt_lens=[16])
+    rids = [srv.submit(ids, pv, budget) for ids, pv, budget in reqs]
+    out = srv.run_until_drained()
+    for rid, (ids, pv, budget) in zip(rids, reqs):
+        assert out[rid] == _oneshot(params, cfg, ids, pv, budget)
+
+
+def test_first_chunk_ramp_speculative_is_dropped(tiny):
+    """Speculative rows commit their first token at admission, so the
+    ramp predicate can never fire — the batcher drops the flag (no dead
+    executable compiled at warmup) and chains stay exact."""
+    cfg, params = tiny
+    ids, pv, budget = [1, 5, -200, 9, 9], _pv(cfg, 3), 10
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=8,
+                            eos_token_id=None, speculative=4, first_chunk=4)
+    assert srv.first_chunk == 0
+    rid = srv.submit(ids, pv, budget)
+    out = srv.run_until_drained()
+    assert out[rid] == _oneshot(params, cfg, ids, pv, budget)
+
+
+def test_prefix_reuse_text_prefix_equals_oneshot(tiny):
+    """Shared text prefix (system-prompt head): admissions run only their
+    suffix against the cached prefix KV; chains must equal one-shot
+    generate, and non-matching prompts fall back to the full prefill."""
+    cfg, params = tiny
+    system = [1, 5, 7, 7, 8]
+    reqs = [
+        (system + [-200, 9, 9], _pv(cfg, 0), 10),
+        (system + [-200, 11, 3, 4], _pv(cfg, 1), 8),
+        ([2, 6] + [-200, 11], _pv(cfg, 2), 9),  # does NOT match the prefix
+    ]
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None)
+    assert srv.set_prefix(system) == len(system)
+    rids = [srv.submit(ids, pv, budget) for ids, pv, budget in reqs]
+    out = srv.run_until_drained()
+    for rid, (ids, pv, budget) in zip(rids, reqs):
+        assert out[rid] == _oneshot(params, cfg, ids, pv, budget), rid
+
+
+def test_prefix_reuse_event_prefix_equals_oneshot(tiny):
+    """Prefix THROUGH the event block (multi-turn session): suffixes are
+    plain text and skip CLIP encode entirely; exactness must hold."""
+    cfg, params = tiny
+    pv = _pv(cfg, 4)
+    head = [1, 5, -200, 7]
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None)
+    srv.set_prefix(head, pixel_values=pv)
+    reqs = [(head + [9, 9, 12], 10), (head + [3], 8)]
+    rids = [srv.submit(ids, pv, budget) for ids, budget in reqs]
+    out = srv.run_until_drained()
+    for rid, (ids, budget) in zip(rids, reqs):
+        assert out[rid] == _oneshot(params, cfg, ids, pv, budget), rid
+
+
+def test_prefix_reuse_speculative_and_kv_quant(tiny):
+    """Prefix admission composes with the speculative server (prefill
+    argmax commit + Medusa hidden seeding) and the int8 KV cache."""
+    cfg, params = tiny
+    system = [1, 5, 7, 7, 8]
+    ids, pv, budget = system + [-200, 9, 9], _pv(cfg, 5), 10
+    heads = {"w": jax.random.normal(jax.random.PRNGKey(3),
+                                    (3, cfg.llama.hidden_size,
+                                     cfg.llama.hidden_size)) * 0.5}
+    for kw in (dict(speculative=4), dict(speculative=4, draft_head=heads),
+               dict(kv_quant=True)):
+        srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256,
+                                chunk=4, eos_token_id=None, **kw)
+        srv.set_prefix(system)
+        rid = srv.submit(ids, pv, budget)
+        out = srv.run_until_drained()
+        want = _oneshot(params, cfg, ids, pv, budget)
+        assert out[rid] == want, kw
+
+
+def test_prefix_validation(tiny):
+    cfg, params = tiny
+    srv = ContinuousBatcher(params, cfg, max_batch=1, max_len=256, chunk=4,
+                            eos_token_id=None)
+    with pytest.raises(ValueError, match="pixel_values"):
+        srv.set_prefix([1, -200, 5])
+    with pytest.raises(ValueError, match="at most one"):
+        srv.set_prefix([1, -200, -200], _pv(cfg, 0))
