@@ -74,13 +74,14 @@ def synth_event_stream(
             ys.append(fy + jitter[e, :, 1])
             ts.append(rng.integers(t0, t1, size=n_tracks))
             ps.append(pol)
+    from eventgpt_tpu.ops.raster import STREAM_DTYPE
+
     x = np.clip(np.concatenate(xs), 0, _CANVAS - 1)
     y = np.clip(np.concatenate(ys), 0, _CANVAS - 1)
     t = np.concatenate(ts)
     p = np.concatenate(ps)
     order = np.argsort(t, kind="stable")
-    out = np.empty(x.shape[0], dtype=[("x", "<u2"), ("y", "<u2"),
-                                      ("t", "<i8"), ("p", "<u1")])
+    out = np.empty(x.shape[0], dtype=STREAM_DTYPE)  # the ONE shared layout
     out["x"], out["y"] = x[order].astype(np.uint16), y[order].astype(np.uint16)
     out["t"], out["p"] = t[order], p[order].astype(np.uint8)
     return out

@@ -798,6 +798,20 @@ class ContinuousBatcher:
                 chunk=self.first_chunk,
             )
             n += 1
+        if self._prefix is not None:
+            # Prefix-admission executable (_prefix_prefill at the smallest
+            # suffix bucket — query tails; a longer real suffix compiles
+            # its own). The dummy row cache is discarded, nothing touches
+            # the resident state.
+            from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
+
+            dummy = [0] if self._prefix["has_event"] else [EVENT_TOKEN_INDEX]
+            dummy_pv = np.zeros(
+                (self.cfg.num_event_frames, 3, self.cfg.vision.image_size,
+                 self.cfg.vision.image_size), np.float32,
+            )
+            if self._prefix_admit(dummy_pv, dummy) is not None:
+                n += 1
         return n
 
     def set_prefix(self, input_ids: Sequence[int],
@@ -843,6 +857,15 @@ class ContinuousBatcher:
         p_len = int(lens[0])
         grain = 2 * SEQ_BUCKET
         s1p = min(((p_len + grain - 1) // grain) * grain, self.max_len)
+        if p_len + SEQ_BUCKET > self.max_len:
+            # Loud fit check (submit()'s rule): the prefix plus at least
+            # one suffix bucket must fit the server, or every admission
+            # would fall back to full prefill (and the pad below would
+            # crash on a negative width for a prefix past max_len).
+            raise ValueError(
+                f"prefix ({p_len} positions) does not fit server "
+                f"max_len {self.max_len} with room for a suffix"
+            )
         padded = jnp.pad(padded, ((0, 0), (0, s1p - p_len), (0, 0)))
         mask = jnp.pad(mask, ((0, 0), (0, s1p - p_len)))
         row_cache = self._new_row_cache(s1p)
@@ -879,28 +902,25 @@ class ContinuousBatcher:
             return None
         return suffix
 
-    def _prefix_admit(self, req, suffix_ids):
+    def _prefix_admit(self, pixel_values, suffix_ids):
         """Suffix-only admission against the shared prefix KV. Returns
         (row_cache, row_logits, row_hidden, prompt_len), or None when the
-        bucket arithmetic can't host prefix + padded suffix (fall back)."""
+        bucket arithmetic can't host prefix + padded suffix (fall back).
+        The fit check runs BEFORE the CLIP encode, so a falling-back
+        request pays its encode once, on the full-prefill path."""
+        from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
         from eventgpt_tpu.data.tokenizer import split_at_event
         from eventgpt_tpu.models.eventchat import splice_embeddings
 
         pre = self._prefix
         p_len = pre["len"]
         if pre["has_event"]:
-            emb = llama_mod.embed_tokens(
-                self.params["llama"], jnp.asarray([suffix_ids], jnp.int32)
-            )
+            suf_len = len(suffix_ids)
         else:
-            pv = jnp.asarray(req.pixel_values, self._dtype)[None]
-            if self.mesh is not None:
-                pv = self._serving.shard_batch_array(pv, self.mesh)
-            ev = eventchat.encode_events_batch(self.params, self.cfg, pv)
-            emb = splice_embeddings(
-                self.params, self.cfg, split_at_event(suffix_ids), ev[0]
-            )[None]
-        suf_len = emb.shape[1]
+            suf_len = (
+                sum(1 for t in suffix_ids if t != EVENT_TOKEN_INDEX)
+                + self.cfg.num_event_tokens
+            )
         prompt_len = p_len + suf_len
         chunk = ((suf_len + SEQ_BUCKET - 1) // SEQ_BUCKET) * SEQ_BUCKET
         grain = 2 * SEQ_BUCKET
@@ -913,6 +933,19 @@ class ContinuousBatcher:
             # row bucket can't host the prefix's stored block — fall back
             # to the full prefill path.
             return None
+        if pre["has_event"]:
+            emb = llama_mod.embed_tokens(
+                self.params["llama"], jnp.asarray([suffix_ids], jnp.int32)
+            )
+        else:
+            pv = jnp.asarray(pixel_values, self._dtype)[None]
+            if self.mesh is not None:
+                pv = self._serving.shard_batch_array(pv, self.mesh)
+            ev = eventchat.encode_events_batch(self.params, self.cfg, pv)
+            emb = splice_embeddings(
+                self.params, self.cfg, split_at_event(suffix_ids), ev[0]
+            )[None]
+        assert emb.shape[1] == suf_len, (emb.shape, suf_len)
         emb = jnp.pad(emb, ((0, 0), (0, chunk - suf_len), (0, 0)))
         row_cache = self._new_row_cache(s1)
         new_len = jnp.asarray([prompt_len], jnp.int32)
@@ -1182,7 +1215,7 @@ class ContinuousBatcher:
                        if self.rows[r] is None)
             suffix_ids = self._prefix_suffix_ids(req)
             if suffix_ids is not None:
-                pre_admit = self._prefix_admit(req, suffix_ids)
+                pre_admit = self._prefix_admit(req.pixel_values, suffix_ids)
                 if pre_admit is not None:
                     row_cache, row_logits, row_hidden, prompt_len = pre_admit
                     self._finish_admission(

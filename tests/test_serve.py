@@ -395,3 +395,26 @@ def test_prefix_validation(tiny):
         srv.set_prefix([1, -200, 5])
     with pytest.raises(ValueError, match="at most one"):
         srv.set_prefix([1, -200, -200], _pv(cfg, 0))
+
+
+def test_prefix_warmup_and_fit_check(tiny):
+    """warmup() precompiles the prefix-admission executable (its contract:
+    no request pays a compile mid-service), and an oversized prefix fails
+    loudly at set_prefix, not as a pad crash."""
+    cfg, params = tiny
+    srv = ContinuousBatcher(params, cfg, max_batch=1, max_len=256, chunk=4,
+                            eos_token_id=None)
+    base = ContinuousBatcher(params, cfg, max_batch=1, max_len=256, chunk=4,
+                             eos_token_id=None)
+    n_base = base.warmup(prompt_lens=[16])
+    srv.set_prefix([1, 5, 7])
+    assert srv.warmup(prompt_lens=[16]) == n_base + 1  # + prefix executable
+    ids, pv = [1, 5, 7, -200, 9], _pv(cfg, 6)
+    rid = srv.submit(ids, pv, 6)
+    out = srv.run_until_drained()
+    assert out[rid] == _oneshot(params, cfg, ids, pv, 6)
+
+    tight = ContinuousBatcher(params, cfg, max_batch=1, max_len=128, chunk=4,
+                              eos_token_id=None)
+    with pytest.raises(ValueError, match="does not fit"):
+        tight.set_prefix(list(range(1, 120)))
