@@ -76,6 +76,18 @@ class LlamaConfig:
     # 16.9G of HLO temps on v5e) and cannot fit one chip.
     remat: bool = True
 
+    _ATTN_IMPLS = ("dense", "flash", "ring", "ulysses")
+
+    def __post_init__(self):
+        if self.attn_impl not in self._ATTN_IMPLS:
+            # llama.prefill dispatches on this string and treats anything
+            # unrecognized as dense — a typo would silently drop flash or
+            # sequence parallelism instead of failing.
+            raise ValueError(
+                f"attn_impl must be one of {self._ATTN_IMPLS}, "
+                f"got {self.attn_impl!r}"
+            )
+
     def resolved_head_dim(self) -> int:
         return self.head_dim if self.head_dim is not None else self.hidden_size // self.num_heads
 

@@ -327,26 +327,53 @@ def launch_multiprocess_dryrun(
             with open(out_path, "w") as fo, open(err_path, "w") as fe:
                 procs.append(subprocess.Popen(
                     cmd, env=env, cwd=repo, stdout=fo, stderr=fe))
+        # Round-robin poll rather than sequential waits: whichever rank
+        # dies first must surface immediately — its survivors are blocked
+        # in collectives that can never complete, and a sequential wait on
+        # a lower-indexed survivor would burn the whole timeout and then
+        # misreport the crash as a coordinator deadlock.
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        pending = set(range(n_processes))
+        failed_rank = None
+        while pending:
+            for rank in sorted(pending):
+                rc = procs[rank].poll()
+                if rc is None:
+                    continue
+                pending.discard(rank)
+                if rc != 0 and failed_rank is None:
+                    failed_rank = rank
+            if failed_rank is not None and pending:
+                # Short grace for survivors, then put them down.
+                grace = _time.monotonic() + 5.0
+                while pending and _time.monotonic() < grace:
+                    for rank in list(pending):
+                        if procs[rank].poll() is not None:
+                            pending.discard(rank)
+                    _time.sleep(0.1)
+                for rank in pending:
+                    procs[rank].kill()
+                    procs[rank].wait()
+                pending.clear()
+            elif pending:
+                if _time.monotonic() > deadline:
+                    for q in procs:
+                        q.kill()
+                    raise RuntimeError(
+                        f"multiproc workers timed out after {timeout}s "
+                        "(coordinator deadlock?)")
+                _time.sleep(0.2)
         outs = []
-        failure = None
-        for rank, p in enumerate(procs):
-            try:
-                p.wait(timeout=timeout)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                raise RuntimeError(
-                    f"multiproc worker rank {rank} timed out after {timeout}s "
-                    "(coordinator deadlock?)")
+        for rank in range(n_processes):
             with open(logs[rank][0]) as fo, open(logs[rank][1]) as fe:
-                out, err = fo.read(), fe.read()
-            outs.append((out, err))
-            if p.returncode != 0 and failure is None:
-                failure = (rank, p.returncode, err)
-        if failure is not None:
-            rank, rc, err = failure
+                outs.append((fo.read(), fe.read()))
+        if failed_rank is not None:
             raise RuntimeError(
-                f"multiproc worker rank {rank} failed (rc={rc}):\n{err[-3000:]}")
+                f"multiproc worker rank {failed_rank} failed "
+                f"(rc={procs[failed_rank].returncode}):\n"
+                f"{outs[failed_rank][1][-3000:]}")
         results = [_parse_result(out, f"rank {i}") for i, (out, _) in enumerate(outs)]
 
         # Single-process reference: the identical global program on one
