@@ -309,10 +309,10 @@ def launch_multiprocess_dryrun(
             "EGPT_MP_OUTDIR": outdir,
             "EGPT_MP_ATTN": attn_impl,
         }
-        # Worker output goes to FILES, not pipes: the parent waits on the
-        # ranks sequentially, and a rank blocked writing into an undrained
-        # 64 KiB pipe would stall out of its collectives — turning any
-        # verbose crash into a generic cross-rank timeout.
+        # Worker output goes to FILES, not pipes: a rank blocked writing
+        # into an undrained 64 KiB pipe would stall out of its collectives
+        # — turning any verbose crash into a generic cross-rank timeout —
+        # and files let the poll loop below read everything post-mortem.
         procs = []
         logs = []
         for rank in range(n_processes):
@@ -359,11 +359,21 @@ def launch_multiprocess_dryrun(
                 pending.clear()
             elif pending:
                 if _time.monotonic() > deadline:
+                    stuck = sorted(pending)
                     for q in procs:
                         q.kill()
+                    tails = []
+                    for rank in stuck:
+                        try:
+                            with open(logs[rank][1]) as fe:
+                                tails.append(f"-- rank {rank} stderr --\n"
+                                             f"{fe.read()[-1000:]}")
+                        except OSError:
+                            pass
                     raise RuntimeError(
-                        f"multiproc workers timed out after {timeout}s "
-                        "(coordinator deadlock?)")
+                        f"multiproc ranks {stuck} still running after "
+                        f"{timeout}s (coordinator deadlock?)\n"
+                        + "\n".join(tails))
                 _time.sleep(0.2)
         outs = []
         for rank in range(n_processes):

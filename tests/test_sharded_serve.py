@@ -211,3 +211,38 @@ def test_13b_sharded_server_segment_compiles():
         params_abs, logits_abs, cache_abs, key_abs, frozen_abs, nrem_abs
     ).compile()
     assert compiled is not None
+
+
+def test_sharded_server_prefix_reuse(tiny, mesh8):
+    """Shared-prefix KV reuse under the serving mesh: the sharded
+    suffix-prefill executable (_get_sharded_prefix_prefill, pinned
+    out-shardings) must commit the same chains as one-shot generate for
+    both prefix regimes, with fallback intact; the ramp composes."""
+    cfg, params = tiny
+    sharded = shard_params_for_serving(params, cfg, mesh8)
+    system = [1, 5, 7, 7, 8]
+
+    srv = ContinuousBatcher(sharded, cfg, mesh=mesh8, max_batch=2,
+                            max_len=256, chunk=4, eos_token_id=None,
+                            first_chunk=2)
+    assert srv.set_prefix(system) == len(system)
+    reqs = [
+        (system + [-200, 9, 9], 0, 10),
+        (system + [-200, 11, 3], 1, 8),
+        ([2, 6, -200, 11], 2, 9),  # non-matching: full-prefill fallback
+    ]
+    rids = [srv.submit(ids, _pv(cfg, s), b) for ids, s, b in reqs]
+    out = srv.run_until_drained()
+    for rid, (ids, s, b) in zip(rids, reqs):
+        assert out[rid] == _oneshot(params, cfg, ids, _pv(cfg, s), b), rid
+
+    # Event-block prefix (multi-turn session): suffixes skip CLIP encode.
+    pv = _pv(cfg, 4)
+    head = [1, 5, -200, 7]
+    srv2 = ContinuousBatcher(sharded, cfg, mesh=mesh8, max_batch=2,
+                             max_len=256, chunk=4, eos_token_id=None)
+    srv2.set_prefix(head, pixel_values=pv)
+    srv2.warmup(prompt_lens=[16])  # incl. the sharded prefix executable
+    rid = srv2.submit(head + [9, 9, 12], pv, 10)
+    out2 = srv2.run_until_drained()
+    assert out2[rid] == _oneshot(params, cfg, head + [9, 9, 12], pv, 10)
