@@ -955,23 +955,24 @@ def run_all(args):
         except Exception as e2:
             sys.stderr.write(f"serve b8 int8 leg failed: {e2}\n")
 
-    # Batch-16 shared-prefix leg (r5): session prefix (system + event)
-    # cached once, admissions prefill only the query tail — the +36%
-    # answer to r4's "bounded by the 16 per-request prefills".
-    try:
-        sv16 = _leg(["--mode", "serve", "--preset", args.preset,
-                     "--quant", args.quant, "--decode_tokens", "128",
-                     "--serve_requests", "16", "--serve_batch", "16",
-                     "--kv", "int8", "--warmup", "1", "--serve_prefix", "1",
-                     # Ramp stacks with prefix reuse here: measured 487
-                     # tok/s at TTFT p50 1.39 s vs 467-530 @ 3.9-4.4 s
-                     # without it (single admission wave + cheap suffix
-                     # prefills make the short first segment ~free).
-                     "--serve_first_chunk", "16"])
-        record["serve_b16_prefix_tok_s"] = sv16["value"]
-        record["serve_b16_prefix_ttft_p50_s"] = sv16["ttft_p50_s"]
-    except Exception as e:
-        sys.stderr.write(f"serve b16 prefix leg failed: {e}\n")
+    # Shared-prefix serving legs (r5): session prefix (system + event)
+    # cached once, admissions prefill only the query tail, plus the TTFT
+    # ramp (with suffix prefills this cheap the short first segment is
+    # ~free). Batch 16 answers r4's "bounded by the 16 per-request
+    # prefills" (+36%); batch 32 is the single-chip ceiling (b40 OOMs at
+    # runtime, b48 at compile).
+    for width in (16, 32):
+        try:
+            sv = _leg(["--mode", "serve", "--preset", args.preset,
+                       "--quant", args.quant, "--decode_tokens", "128",
+                       "--serve_requests", str(width),
+                       "--serve_batch", str(width),
+                       "--kv", "int8", "--warmup", "1",
+                       "--serve_prefix", "1", "--serve_first_chunk", "16"])
+            record[f"serve_b{width}_prefix_tok_s"] = sv["value"]
+            record[f"serve_b{width}_prefix_ttft_p50_s"] = sv["ttft_p50_s"]
+        except Exception as e:
+            sys.stderr.write(f"serve b{width} prefix leg failed: {e}\n")
 
     print(json.dumps(record))
 

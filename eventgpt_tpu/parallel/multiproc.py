@@ -362,6 +362,7 @@ def launch_multiprocess_dryrun(
                     stuck = sorted(pending)
                     for q in procs:
                         q.kill()
+                        q.wait()  # reap before reading logs (no zombies)
                     tails = []
                     for rank in stuck:
                         try:
