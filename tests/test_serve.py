@@ -418,3 +418,40 @@ def test_prefix_warmup_and_fit_check(tiny):
                               eos_token_id=None)
     with pytest.raises(ValueError, match="does not fit"):
         tight.set_prefix(list(range(1, 120)))
+
+
+def test_prefix_takes_precedence_over_chunked_prefill(tiny):
+    """With both prefill_chunk and a prefix set, matching requests use the
+    (cheap, one-shot) suffix prefill; non-matching ones still go through
+    the chunked-admission machinery. Chains stay exact either way."""
+    cfg, params = tiny
+    system = [1, 5, 7, 7, 8]
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None, prefill_chunk=8)
+    srv.set_prefix(system)
+    reqs = [
+        (system + [-200, 9, 9], 0, 8),   # prefix path
+        ([2, 6, -200, 11], 1, 8),        # fallback; chunked once decoding
+        (system + [-200, 3], 2, 6),      # prefix path again
+    ]
+    rids = [srv.submit(ids, _pv(cfg, s), b) for ids, s, b in reqs]
+    out = srv.run_until_drained()
+    for rid, (ids, s, b) in zip(rids, reqs):
+        assert out[rid] == _oneshot(params, cfg, ids, _pv(cfg, s), b), rid
+
+
+def test_first_chunk_ramp_with_eos_in_ramp_segment(tiny):
+    """A row whose EOS lands inside the short ramp segment freezes there
+    and matches the eos-stopped one-shot chain."""
+    cfg, params = tiny
+    ids, pv = [1, 5, -200, 9, 9], _pv(cfg, 0)
+    full = _oneshot(params, cfg, ids, pv, 12)
+    eos = full[1]  # stop within the 3-token ramp
+    want = _oneshot(params, cfg, ids, pv, 12, eos=eos)
+    assert len(want) < 4
+    srv = ContinuousBatcher(params, cfg, max_batch=1, max_len=256, chunk=8,
+                            eos_token_id=eos, first_chunk=3)
+    rid = srv.submit(ids, pv, 12)
+    follow = srv.submit(ids, pv, 12)  # row recycles after the ramp freeze
+    out = srv.run_until_drained()
+    assert out[rid] == want and out[follow] == want
