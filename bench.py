@@ -240,7 +240,7 @@ def run_decode(args):
                        ("RESOURCE_EXHAUSTED", "ResourceExhausted",
                         "Ran out of memory"))
 
-        sweep, sweep_kv = {}, {}
+        sweep, sweep_kv, sweep_retries = {}, {}, {}
         # Monotonicity only holds among the sweep's own bf16 points; the
         # headline tok_s is a valid predecessor only for batch-1 bf16.
         prev = tok_s if (args.batch == 1 and args.kv == "bf16") else 0.0
@@ -254,11 +254,16 @@ def run_decode(args):
                     # Aggregate decode throughput is monotone in batch on
                     # this chip; a point far below its predecessor is a
                     # transient tunnel glitch (observed once: 56 tok/s at
-                    # batch 8 vs 475 on the immediate re-run). One retry.
+                    # batch 8 vs 475 on the immediate re-run). One retry —
+                    # BOTH measurements recorded (ADVICE r5: a silent
+                    # max() can mask a real batch-scaling regression as a
+                    # glitch; batch_sweep_retries keeps the evidence).
                     sys.stderr.write(
                         f"sweep batch {b}: {r:.1f} tok/s < 0.8x previous "
                         f"({prev:.1f}) — transient glitch, re-measuring\n")
                     r2, _, _ = measure(b, "bf16")
+                    sweep_retries[str(b)] = {
+                        "first": round(r, 2), "retry": round(r2, 2)}
                     r = max(r, r2)
                 prev = max(prev, r)
                 sweep[str(b)], sweep_kv[str(b)] = round(r, 2), "bf16"
@@ -274,6 +279,8 @@ def run_decode(args):
                     sweep[str(b)], sweep_kv[str(b)] = "oom", "int8"
         extras["batch_sweep_tok_s"] = sweep
         extras["batch_sweep_kv"] = sweep_kv
+        if sweep_retries:
+            extras["batch_sweep_retries"] = sweep_retries
 
     record = {
         "metric": f"tokens_per_sec_per_chip_{preset}_decode",
@@ -500,27 +507,31 @@ def run_stream(args):
     # Prompt shape of the inference CLI run (system + query + event block).
     ids = [1] + [7] * 34 + [-200] + [9] * 16
 
-    # Reference sample -> structured stream the native reader consumes.
-    stream_path = os.path.join(tempfile.gettempdir(), "bench_stream.npy")
-    np.save(stream_path, events_to_structured_stream(load_event_npy(SAMPLE)))
-
     window_s = args.stream_window_ms / 1e3
     answer_budget = 32
     firsts, completes, counts = [], [], []
-    with EventStream(stream_path) as stream:
-        # Unpaced replay: drain everything, then window on event time —
-        # the measured quantity is processing latency per available
-        # window, which paced replay would only pad with idle waiting.
-        buf = {k: np.empty(0, d) for k, d in
-               (("x", np.uint16), ("y", np.uint16),
-                ("t", np.float64), ("p", np.uint8))}
-        while True:
-            out = stream.pop_until(1e18)
-            if out["t"].size:
-                buf = {k: np.concatenate([buf[k], out[k]]) for k in buf}
-            if not stream.running():
-                break
-            time.sleep(0.002)
+    # Reference sample -> structured stream the native reader consumes.
+    # Private per-run directory, not a fixed name in the shared tmp dir
+    # (ADVICE r5: concurrent runs clobbered each other, and a pre-placed
+    # symlink at the world-writable path could redirect the np.save).
+    with tempfile.TemporaryDirectory(prefix="egpt_bench_") as stream_dir:
+        stream_path = os.path.join(stream_dir, "bench_stream.npy")
+        np.save(stream_path,
+                events_to_structured_stream(load_event_npy(SAMPLE)))
+        with EventStream(stream_path) as stream:
+            # Unpaced replay: drain everything, then window on event time —
+            # the measured quantity is processing latency per available
+            # window, which paced replay would only pad with idle waiting.
+            buf = {k: np.empty(0, d) for k, d in
+                   (("x", np.uint16), ("y", np.uint16),
+                    ("t", np.float64), ("p", np.uint8))}
+            while True:
+                out = stream.pop_until(1e18)
+                if out["t"].size:
+                    buf = {k: np.concatenate([buf[k], out[k]]) for k in buf}
+                if not stream.running():
+                    break
+                time.sleep(0.002)
     t_all = buf["t"]
     cursor = float(t_all.min())
 

@@ -45,7 +45,9 @@ import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict
+from typing import Any, Dict, Optional
+
+from eventgpt_tpu import faults  # stdlib-only; safe before jax loads
 
 
 class ServingEngine:
@@ -56,9 +58,26 @@ class ServingEngine:
     lock and runs the scheduler loop on a dedicated thread, parking it
     when no work exists. HTTP handler threads only do host-side prep
     (event file -> pixels, tokenize) and block on per-request events.
+
+    Request-lifecycle hardening: a scheduler-thread exception no longer
+    kills the engine for good. The dying thread fails the in-flight rows
+    cleanly (their waiters/streams get the fault), keeps queued requests
+    for re-admission, and RESTARTS the scheduler thread. A circuit
+    breaker counts consecutive faults: at ``breaker_threshold`` it trips
+    — queued requests are failed too, ``/health`` flips to ``degraded``
+    and submits are refused (503) until ``breaker_cooldown_s`` elapses
+    (half-open: traffic is admitted again; the first clean step closes
+    the breaker, the next fault re-trips it instantly). ``heartbeat_dir``
+    arms the same atomic liveness file the trainer writes
+    (``train/resilience.Heartbeat``) so one external watchdog convention
+    covers both.
     """
 
-    def __init__(self, batcher, tokenizer, conv_mode: str = "eventgpt_v1"):
+    def __init__(self, batcher, tokenizer, conv_mode: str = "eventgpt_v1",
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 5.0,
+                 heartbeat_dir: Optional[str] = None,
+                 heartbeat_interval_s: float = 1.0):
         self.batcher = batcher
         self.tokenizer = tokenizer
         self.conv_mode = conv_mode
@@ -67,12 +86,27 @@ class ServingEngine:
         self._stop = False
         self._done: Dict[int, threading.Event] = {}
         self._answers: Dict[int, list] = {}
+        self._status: Dict[int, str] = {}  # terminal status per rid
         self._streams: Dict[int, queue.Queue] = {}
         self._sent: Dict[int, int] = {}
         self._abandoned: set = set()  # timed-out rids: drop at harvest
         self.n_requests = 0
         self.t_start = time.time()
-        self.fault: Any = None  # repr of a scheduler-thread death, if any
+        self.fault: Any = None  # repr of the LAST scheduler fault
+        self.n_faults = 0          # total scheduler faults survived
+        self.n_restarts = 0        # scheduler-thread restarts
+        self._consec_faults = 0    # consecutive (no clean step between)
+        self._t_fault = 0.0        # monotonic time of the last fault
+        self.breaker_threshold = max(int(breaker_threshold), 1)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self._n_steps = 0
+        self._heartbeat = None
+        self._hb_interval = float(heartbeat_interval_s)
+        self._last_beat = 0.0
+        if heartbeat_dir:
+            from eventgpt_tpu.train.resilience import Heartbeat
+
+            self._heartbeat = Heartbeat(heartbeat_dir)
         # Lock-free stats snapshot: /health and /stats must answer inside
         # a load balancer's probe timeout even while the scheduler thread
         # holds the lock through a multi-second decode segment. Rebuilt
@@ -83,23 +117,33 @@ class ServingEngine:
 
     # -- client side ------------------------------------------------------
 
+    def breaker_open(self) -> bool:
+        """True while the circuit breaker refuses new work: the fault
+        count hit the threshold and the cooldown has not elapsed. After
+        the cooldown the breaker is HALF-OPEN — submits flow again, one
+        clean step resets the count, one more fault re-trips."""
+        return (self._consec_faults >= self.breaker_threshold
+                and time.monotonic() - self._t_fault < self.breaker_cooldown_s)
+
     def submit(self, query: str, pixels, max_new_tokens: int,
-               stream: bool = False) -> int:
+               stream: bool = False,
+               deadline_s: Optional[float] = None) -> int:
         from eventgpt_tpu.data.conversation import prepare_event_prompt
         from eventgpt_tpu.data.tokenizer import tokenize_with_event
 
-        if self.fault is not None:
+        if self.breaker_open():
             raise RuntimeError(f"serving engine is down: {self.fault}")
         ids = tokenize_with_event(
             prepare_event_prompt(query, self.conv_mode), self.tokenizer
         )
         with self._lock:
-            # Re-check under the lock: a fault landing while we tokenized
+            # Re-check under the lock: a breaker trip while we tokenized
             # has already swept _done — an event registered after the
             # sweep would burn its caller's full timeout.
-            if self.fault is not None:
+            if self.breaker_open():
                 raise RuntimeError(f"serving engine is down: {self.fault}")
-            rid = self.batcher.submit(ids, pixels, max_new_tokens)
+            rid = self.batcher.submit(ids, pixels, max_new_tokens,
+                                      deadline_s=deadline_s)
             self._done[rid] = threading.Event()
             if stream:
                 self._streams[rid] = queue.Queue()
@@ -107,6 +151,24 @@ class ServingEngine:
             self.n_requests += 1
         self._wake.set()
         return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a queued or in-flight request; its waiter is released
+        with whatever tokens were committed, under status ``cancelled``.
+        False when the rid is unknown or already finished."""
+        with self._lock:
+            ok = self.batcher.cancel(rid)
+            if ok:
+                self._harvest()
+                self._snapshot = self._build_snapshot()
+        if ok:
+            self._wake.set()
+        return ok
+
+    def status(self, rid: int) -> str:
+        """Terminal status of a finished request ('ok' when it finished
+        normally or is unknown/still running)."""
+        return self._status.get(rid, "ok")
 
     def result(self, rid: int, timeout: float = 600.0):
         """Block until the request finishes; returns its token ids."""
@@ -126,7 +188,9 @@ class ServingEngine:
         with self._lock:
             self._done.pop(rid, None)
             if rid not in self._answers:
-                raise RuntimeError(f"serving engine is down: {self.fault}")
+                raise RuntimeError(
+                    f"serving engine is down: "
+                    f"{self.fault or self._status.get(rid, 'unknown fault')}")
             return self._answers.pop(rid)
 
     def stream_queue(self, rid: int) -> queue.Queue:
@@ -143,7 +207,10 @@ class ServingEngine:
             "queued": len(b.queue),
             "max_batch": b.max_batch,
             "max_len": b.max_len,
+            "max_queue": b.max_queue,
             "speculative": b.speculative,
+            "faults": self.n_faults,
+            "restarts": self.n_restarts,
             "admission_s": round(b.admission_s, 3),
             **({"spec_tokens_per_iteration":
                 round(b.spec_tokens_per_iteration(), 2)}
@@ -162,6 +229,7 @@ class ServingEngine:
         return {
             "uptime_s": round(time.time() - self.t_start, 1),
             "requests": self.n_requests,
+            "status": "degraded" if self.breaker_open() else "ok",
             **self._snapshot,
         }
 
@@ -175,6 +243,7 @@ class ServingEngine:
     def _loop(self) -> None:
         while not self._stop:
             try:
+                faults.maybe_fail("serve.loop")
                 with self._lock:
                     busy = (self.batcher.queue
                             or any(r is not None for r in self.batcher.rows))
@@ -182,34 +251,95 @@ class ServingEngine:
                         self.batcher.step()
                         self._push_stream_deltas()
                         self._harvest()
+                        self._n_steps += 1
+                        if self._consec_faults:
+                            # A clean step closes the breaker: the fault
+                            # streak is over and /health returns to ok.
+                            self._consec_faults = 0
+                            self.fault = None
                         # Snapshot only when state moved (idle polls would
                         # rebuild 10x/s for nothing); submits wake the
                         # loop, so queue growth shows within one pass.
                         self._snapshot = self._build_snapshot()
             except Exception as e:  # scheduler death must be LOUD
-                self._fail(e)
+                self._on_fault(e)
+                if not self._stop:
+                    # Restart the scheduler on a FRESH thread (the fault
+                    # may have left this one's stack in a weird spot);
+                    # brief backoff so a hard fault loop cannot spin.
+                    time.sleep(min(0.05 * self._consec_faults, 0.5))
+                    self.n_restarts += 1
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True)
+                    self._thread.start()
                 return
             if not busy:
+                self._maybe_beat()
                 self._wake.wait(timeout=0.1)
                 self._wake.clear()
+            else:
+                self._maybe_beat()
 
-    def _fail(self, e: Exception) -> None:
-        """A step() exception would otherwise kill this daemon thread
-        silently while /health kept answering ok from the last snapshot
-        and every waiter burned its full timeout. Record the fault, wake
-        every waiter and stream, and refuse new work."""
+    def _maybe_beat(self) -> None:
+        """Serving liveness beat (same file format + staleness predicate
+        as the trainer's): step count, queue depth, breaker state."""
+        if self._heartbeat is None:
+            return
+        now = time.monotonic()
+        if now - self._last_beat < self._hb_interval:
+            return
+        self._last_beat = now
+        try:
+            s = self._snapshot
+            self._heartbeat.beat(
+                self._n_steps,
+                status="degraded" if self.breaker_open() else "ok",
+                active=s.get("active_rows", 0), queued=s.get("queued", 0),
+                faults=self.n_faults, restarts=self.n_restarts,
+            )
+        except OSError:
+            pass  # liveness reporting must never kill the scheduler
+
+    def _on_fault(self, e: Exception) -> None:
+        """One scheduler fault: fail the IN-FLIGHT rows cleanly (their
+        waiters get the fault instead of burning timeouts), keep queued
+        requests for the restarted scheduler to re-admit, and trip the
+        circuit breaker when the streak reaches the threshold (then
+        queued requests are failed too and submits are refused until the
+        cooldown's half-open probe)."""
         self.fault = repr(e)
+        self.n_faults += 1
+        self._consec_faults += 1
+        self._t_fault = time.monotonic()
+        tripped = self._consec_faults >= self.breaker_threshold
         with self._lock:
-            for q in self._streams.values():
-                # A dict sentinel, not None: the stream handler must
-                # surface the fault, not end the body as a normal done.
-                q.put({"fault": self.fault})
-            self._streams.clear()
-            self._sent.clear()
-            for ev in self._done.values():
-                ev.set()  # result() sees no answer -> raises the fault
-            self._abandoned.clear()
-            self.batcher.queue.clear()
+            b = self.batcher
+            failed = []
+            for r, req in enumerate(b.rows):
+                if req is None:
+                    continue
+                b.rows[r] = None
+                b.frozen[r] = True
+                b.n_rem[r] = 0
+                failed.append(req.rid)
+            b._pending = None
+            if tripped:
+                failed.extend(req.rid for req in b.queue)
+                b.queue.clear()
+            for rid in failed:
+                self._status[rid] = "engine_fault"
+                if rid in self._streams:
+                    # A dict sentinel, not None: the stream handler must
+                    # surface the fault, not end the body as a normal done.
+                    self._streams.pop(rid).put({"fault": self.fault})
+                    self._sent.pop(rid, None)
+                    self._done.pop(rid, None)
+                elif rid in self._done:
+                    # result() sees no answer -> raises the fault (the
+                    # entry stays for a waiter that arrives post-sweep).
+                    self._done[rid].set()
+                self._abandoned.discard(rid)
+            self._snapshot = self._build_snapshot()
 
     def _push_stream_deltas(self) -> None:
         for req in self.batcher.rows:
@@ -225,11 +355,18 @@ class ServingEngine:
             return
         done, self.batcher.finished = self.batcher.finished, {}
         for rid, toks in done.items():
+            status = self.batcher.finish_status.pop(rid, "ok")
             if rid in self._abandoned:
                 # Its waiter timed out and went away; keeping the answer
                 # would leak it (result() registered the drop).
                 self._abandoned.discard(rid)
                 continue
+            # Bounded terminal-status map (same oldest-first rule as the
+            # batcher's request_stats): the handler reads it right after
+            # result(), eviction only matters for abandoned waiters.
+            while len(self._status) >= 8192:
+                self._status.pop(next(iter(self._status)))
+            self._status[rid] = status
             if rid in self._streams:
                 # Stream consumers hold their own queue reference; drop
                 # ALL engine-side state here — a streamed request never
@@ -238,7 +375,9 @@ class ServingEngine:
                 # request_stats for the same reason).
                 q = self._streams.pop(rid)
                 q.put(list(toks))
-                q.put(None)
+                # None = finished normally; a status dict = forced finish
+                # (deadline/cancel/quarantine) the handler must surface.
+                q.put(None if status == "ok" else {"status": status})
                 self._sent.pop(rid, None)
                 self._done.pop(rid, None)
                 continue
@@ -288,7 +427,8 @@ def _decode_pixels(payload: Dict[str, Any], cfg, event_root=None):
 
 def make_handler(engine: ServingEngine, cfg, event_root=None,
                  default_budget: int = 64,
-                 max_body_bytes: int = 32 * 1024 * 1024):
+                 max_body_bytes: int = 32 * 1024 * 1024,
+                 default_deadline_s: Optional[float] = None):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -305,21 +445,26 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
 
         def do_GET(self):
             if self.path == "/health":
-                if engine.fault is not None:
-                    self._json(503, {"status": "fault",
-                                     "error": engine.fault})
+                if engine.breaker_open():
+                    # Breaker open: the load balancer should drain this
+                    # replica until the cooldown's half-open probe.
+                    self._json(503, {"status": "degraded",
+                                     "error": engine.fault,
+                                     "faults": engine.n_faults,
+                                     "restarts": engine.n_restarts})
                     return
                 s = engine.stats()
                 self._json(200, {"status": "ok",
                                  "active": s["active_rows"],
-                                 "queued": s["queued"]})
+                                 "queued": s["queued"],
+                                 "restarts": engine.n_restarts})
             elif self.path == "/stats":
                 self._json(200, engine.stats())
             else:
                 self._json(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
-            if self.path != "/v1/generate":
+            if self.path not in ("/v1/generate", "/cancel"):
                 self._json(404, {"error": f"no route {self.path}"})
                 return
             try:
@@ -346,10 +491,24 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                                  f"{max_body_bytes}-byte limit "
                                  f"(--max_body_mb)"})
                 return
+            if self.path == "/cancel":
+                try:
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    rid = int(payload["rid"])
+                except Exception as e:  # bad request
+                    self._json(400, {"error": str(e)})
+                    return
+                self._json(200, {"rid": rid,
+                                 "cancelled": engine.cancel(rid)})
+                return
+            from eventgpt_tpu.serve import QueueFullError
+
             try:
                 payload = json.loads(self.rfile.read(n) or b"{}")
                 query = payload["query"]
                 budget = int(payload.get("max_new_tokens", default_budget))
+                deadline = payload.get("deadline_s", default_deadline_s)
+                deadline = float(deadline) if deadline else None
                 pixels = _decode_pixels(payload, cfg, event_root)
             except Exception as e:  # bad request, not a server fault
                 self._json(400, {"error": str(e)})
@@ -357,14 +516,26 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
             stream = bool(payload.get("stream", False))
             t0 = time.perf_counter()
             try:
-                rid = engine.submit(query, pixels, budget, stream=stream)
+                rid = engine.submit(query, pixels, budget, stream=stream,
+                                    deadline_s=deadline)
+            except QueueFullError as e:
+                # Backpressure, not failure: tell the client to come back
+                # (bounded admission queue — ISSUE 1 tentpole).
+                body = json.dumps({"error": str(e)}).encode()
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After", "1")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             except ValueError as e:
                 # submit()'s own validation (budget does not fit max_len,
                 # malformed sentinel count) is still the client's fault.
                 self._json(400, {"error": str(e)})
                 return
             except RuntimeError as e:
-                # Engine faulted (scheduler thread died): surface the loud
+                # Engine degraded (circuit breaker open): surface the loud
                 # 503 /health already advertises instead of letting this
                 # handler thread throw and drop the connection.
                 self._json(503, {"error": str(e)})
@@ -380,17 +551,37 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                 return
             try:
                 toks = engine.result(rid)
+            except RuntimeError as e:
+                # Scheduler fault failed this request (engine restarted
+                # behind it) — same 503 contract as a refused submit.
+                self._json(503, {"error": str(e)})
+                return
+            except Exception as e:
+                self._json(500, {"error": str(e)})
+                return
+            try:
                 text = engine.tokenizer.batch_decode(
                     [toks], skip_special_tokens=True
                 )[0].strip()
+                status = engine.status(rid)
                 stats = engine.batcher.request_stats.get(rid, {})
-                self._json(200, {
+                obj = {
                     "answer": text, "tokens": len(toks), "rid": rid,
+                    "status": status,
                     "ttft_s": round(stats.get("ttft_s", 0.0), 3),
                     "latency_s": round(
                         stats.get("latency_s",
                                   time.perf_counter() - t0), 3),
-                })
+                }
+                # Forced finishes map to structured HTTP errors (the
+                # partial answer rides along): deadline -> 504,
+                # cancel -> 499 (client asked), NaN quarantine -> 500.
+                code = {"ok": 200, "deadline_exceeded": 504,
+                        "cancelled": 499,
+                        "nan_quarantined": 500}.get(status, 500)
+                if code != 200:
+                    obj["error"] = status
+                self._json(code, obj)
             except Exception as e:
                 self._json(500, {"error": str(e)})
 
@@ -435,8 +626,10 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                 toks = q.get()
                 if toks is None:
                     break
-                if isinstance(toks, dict):  # engine fault sentinel
-                    chunk({"done": True, "rid": rid,
+                if isinstance(toks, dict):
+                    if "status" in toks:  # forced finish (deadline/
+                        break             # cancel/quarantine): terminal
+                    chunk({"done": True, "rid": rid,  # engine fault
                            "error": toks["fault"],
                            "answer": sent.strip()})
                     self.wfile.write(b"0\r\n\r\n")
@@ -446,7 +639,12 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                 )[0]
                 emit(text.rstrip("�"))
             emit(text)  # flush any held-back tail, rewritten or not
-            chunk({"done": True, "rid": rid, "answer": sent.strip()})
+            status = engine.status(rid)
+            final = {"done": True, "rid": rid, "answer": sent.strip(),
+                     "status": status}
+            if status != "ok":
+                final["error"] = status
+            chunk(final)
             self.wfile.write(b"0\r\n\r\n")
 
     return Handler
@@ -474,6 +672,10 @@ def build_server(args) -> tuple:
         from eventgpt_tpu.models.medusa import load_medusa
 
         draft_head = load_medusa(args.draft_head)
+    if getattr(args, "faults", None):
+        # Arm fault injection from the CLI (EGPT_FAULTS works too): chaos
+        # drills against a live server use the same spec grammar as tests.
+        faults.configure(getattr(args, "faults"))
     batcher = ContinuousBatcher(
         params, cfg, max_batch=args.max_batch, max_len=args.max_len,
         chunk=args.chunk, temperature=args.temperature,
@@ -482,19 +684,27 @@ def build_server(args) -> tuple:
         mesh=mesh, prefill_chunk=args.prefill_chunk,
         draft_head=draft_head,
         first_chunk=getattr(args, "first_chunk", 0),
+        max_queue=getattr(args, "max_queue", 0),
     )
     if args.warmup:
         t0 = time.perf_counter()
         n = batcher.warmup()
         print(f"[serve] warmup: {n} executables in "
               f"{time.perf_counter() - t0:.1f}s")
-    engine = ServingEngine(batcher, tokenizer, args.conv_mode)
+    engine = ServingEngine(
+        batcher, tokenizer, args.conv_mode,
+        breaker_threshold=getattr(args, "breaker_threshold", 3),
+        breaker_cooldown_s=getattr(args, "breaker_cooldown_s", 5.0),
+        heartbeat_dir=getattr(args, "heartbeat_dir", None),
+    )
+    default_deadline = getattr(args, "default_deadline_s", 0) or None
     httpd = ThreadingHTTPServer(
         (args.host, args.port),
         make_handler(engine, cfg, getattr(args, "event_root", None),
                      default_budget=getattr(args, "max_new_tokens", 64),
                      max_body_bytes=int(
-                         getattr(args, "max_body_mb", 32) * 1024 * 1024)),
+                         getattr(args, "max_body_mb", 32) * 1024 * 1024),
+                     default_deadline_s=default_deadline),
     )
     return httpd, engine
 
@@ -535,6 +745,27 @@ def main(argv=None):
                         "admission owes its first token (0 = off; "
                         "PERFORMANCE.md serving section for the tradeoff)")
     p.add_argument("--warmup", action="store_true")
+    # -- request-lifecycle hardening (ISSUE 1) --
+    p.add_argument("--max_queue", type=int, default=256,
+                   help="admission-queue bound: submits beyond this get "
+                        "429 + Retry-After (0 = unbounded)")
+    p.add_argument("--default_deadline_s", type=float, default=0.0,
+                   help="per-request deadline applied when the payload "
+                        "has no deadline_s (0 = none); expiry returns 504 "
+                        "with the tokens committed so far")
+    p.add_argument("--breaker_threshold", type=int, default=3,
+                   help="consecutive scheduler faults that trip the "
+                        "circuit breaker (health -> degraded, POSTs 503)")
+    p.add_argument("--breaker_cooldown_s", type=float, default=5.0,
+                   help="seconds the tripped breaker refuses work before "
+                        "the half-open probe admits traffic again")
+    p.add_argument("--heartbeat_dir", default=None,
+                   help="directory for the serving heartbeat.json "
+                        "(train/resilience.py format; unset = disabled)")
+    p.add_argument("--faults", default=None,
+                   help="arm deterministic fault injection, e.g. "
+                        "'serve.step:n=5' (see eventgpt_tpu/faults.py; "
+                        "EGPT_FAULTS env var equivalent)")
     p.add_argument("--mesh_data", type=int, default=1)
     p.add_argument("--mesh_fsdp", type=int, default=1)
     p.add_argument("--mesh_model", type=int, default=1)

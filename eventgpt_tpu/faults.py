@@ -1,0 +1,207 @@
+"""Deterministic, site-keyed fault injection for the serving/training stack.
+
+Every failure path the runtime claims to survive (scheduler death, NaN
+logits, queue overload, slow steps, bootstrap races) must be REACHABLE
+from a test, or the handling code is dead weight that rots. This module
+is the single switch: call sites name themselves
+(``faults.maybe_fail("serve.step")``) and a test/operator chooses which
+sites fire, when, and how — with zero overhead when nothing is armed
+(one module-global ``is None`` check per call).
+
+Spec grammar (``EGPT_FAULTS`` env var, ``--faults`` CLI flags, or
+``faults.configure()``)::
+
+    site:key=value[,key=value];site2:...
+
+  * ``n=K``        fire exactly on the K-th call to the site (1-based) —
+                   the deterministic workhorse for chaos tests;
+  * ``every=K``    fire on every K-th call (periodic flakiness);
+  * ``p=X``        fire with probability X per call, from a per-site
+                   ``random.Random`` seeded by (seed, site) — the SAME
+                   call sequence fires the SAME calls across runs;
+  * ``times=K``    cap total fires at K (default: unlimited for
+                   ``p``/``every``, exactly one for ``n``);
+  * ``delay=S``    ``maybe_delay`` sleeps S seconds per matching call
+                   (same n/every/p gating; default gate = every call).
+
+Examples::
+
+    EGPT_FAULTS="serve.step:n=2"              # 2nd scheduler step dies
+    EGPT_FAULTS="serve.admit:p=0.1,times=3"   # ~10% of admissions, max 3
+    EGPT_FAULTS="train.step:delay=0.05"       # every micro-step +50 ms
+
+Wired sites (grep ``maybe_fail(`` for the authoritative list):
+``serve.step`` / ``serve.admit`` (``ContinuousBatcher``), ``serve.loop``
+(``ServingEngine`` scheduler thread), ``multiproc.launch`` /
+``multiproc.worker`` (``parallel/multiproc.py`` bootstrap), and
+``train.step`` (``Trainer`` micro-batch boundary).
+
+Injected failures raise ``InjectedFault`` (a ``RuntimeError``): the
+handling layers (engine circuit breaker, trainer divergence policy,
+multiproc launcher) must treat it exactly like a real fault — tests that
+catch ``InjectedFault`` specifically are asserting the fault *reached*
+the handler, not that the handler special-cased it.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Dict, Optional
+
+
+class InjectedFault(RuntimeError):
+    """A deterministic test-injected failure (never raised in production
+    unless fault injection was explicitly armed)."""
+
+
+class _Site:
+    __slots__ = ("name", "nth", "every", "p", "times", "delay_s",
+                 "calls", "fires", "_rng")
+
+    def __init__(self, name: str, nth: int = 0, every: int = 0,
+                 p: float = 0.0, times: int = 0, delay_s: float = 0.0,
+                 seed: int = 0):
+        self.name = name
+        self.nth = nth
+        self.every = every
+        self.p = p
+        # n=K without an explicit cap fires exactly once (the K-th call).
+        self.times = times if times else (1 if nth else 0)  # 0 = unlimited
+        self.delay_s = delay_s
+        self.calls = 0
+        self.fires = 0
+        # Seeded per (seed, site): deterministic across runs for the same
+        # call order, decorrelated between sites.
+        self._rng = random.Random(f"{seed}:{name}")
+
+    def should_fire(self) -> bool:
+        self.calls += 1
+        if self.times and self.fires >= self.times:
+            return False
+        hit = False
+        if self.nth:
+            hit = self.calls == self.nth
+        elif self.every:
+            hit = self.calls % self.every == 0
+        elif self.p:
+            hit = self._rng.random() < self.p
+        elif self.delay_s:
+            hit = True  # delay-only spec: gate every call
+        if hit:
+            self.fires += 1
+        return hit
+
+
+class FaultRegistry:
+    """Parsed fault plan: site name -> firing rule. Thread-safe (the
+    serving engine probes sites from scheduler + handler threads)."""
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._sites: Dict[str, _Site] = {}
+        for clause in spec.split(";"):
+            clause = clause.strip()
+            if not clause:
+                continue
+            if ":" not in clause:
+                raise ValueError(
+                    f"bad fault clause {clause!r} (want site:key=value,...)")
+            name, _, kvs = clause.partition(":")
+            kw: Dict[str, float] = {}
+            for kv in kvs.split(","):
+                k, _, v = kv.strip().partition("=")
+                if k not in ("n", "every", "p", "times", "delay"):
+                    raise ValueError(
+                        f"unknown fault key {k!r} in {clause!r} "
+                        f"(known: n, every, p, times, delay)")
+                kw[k] = float(v)
+            self._sites[name.strip()] = _Site(
+                name.strip(), nth=int(kw.get("n", 0)),
+                every=int(kw.get("every", 0)), p=kw.get("p", 0.0),
+                times=int(kw.get("times", 0)), delay_s=kw.get("delay", 0.0),
+                seed=seed,
+            )
+
+    def check(self, site: str, want_delay: bool) -> Optional[_Site]:
+        """Advance the site's call counter; return the site iff it fires.
+
+        A ``delay=`` clause is a delay rule and only ``maybe_delay``
+        drives it; every other clause is a failure rule and only
+        ``maybe_fail`` drives it — a site wired with both probes (the
+        normal wiring) advances each rule's counters exactly once per
+        pass.
+        """
+        s = self._sites.get(site)
+        if s is None or bool(s.delay_s) is not want_delay:
+            return None
+        with self._lock:
+            return s if s.should_fire() else None
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {n: {"calls": s.calls, "fires": s.fires}
+                    for n, s in self._sites.items()}
+
+
+_registry: Optional[FaultRegistry] = None
+
+
+def configure(spec: Optional[str] = None, seed: Optional[int] = None) -> None:
+    """Arm fault injection from ``spec`` (or the ``EGPT_FAULTS`` env var
+    when ``spec`` is None). An empty/missing spec disarms."""
+    global _registry
+    if spec is None:
+        spec = os.environ.get("EGPT_FAULTS", "")
+    if seed is None:
+        seed = int(os.environ.get("EGPT_FAULTS_SEED", "0"))
+    _registry = FaultRegistry(spec, seed) if spec.strip() else None
+
+
+def disable() -> None:
+    global _registry
+    _registry = None
+
+
+def enabled() -> bool:
+    return _registry is not None
+
+
+def stats() -> Dict[str, Dict[str, int]]:
+    """Per-site {calls, fires} counters of the armed registry ({} when
+    disarmed) — the observability hook chaos tests assert against."""
+    return _registry.stats() if _registry is not None else {}
+
+
+def maybe_fail(site: str) -> None:
+    """Raise ``InjectedFault`` when the armed plan says this call of
+    ``site`` fires. No-op (one global load + compare) when disarmed."""
+    if _registry is None:
+        return
+    s = _registry.check(site, want_delay=False)
+    if s is not None:
+        raise InjectedFault(
+            f"injected fault at {site} (call #{s.calls}, fire #{s.fires})")
+
+
+def maybe_delay(site: str) -> float:
+    """Sleep the site's configured delay when its rule fires (``delay=S``
+    clauses only); returns the seconds slept. No-op when disarmed."""
+    if _registry is None:
+        return 0.0
+    s = _registry.check(site, want_delay=True)
+    if s is None:
+        return 0.0
+    time.sleep(s.delay_s)
+    return s.delay_s
+
+
+# Arm from the environment at import: zero-cost when EGPT_FAULTS is unset,
+# and child processes (multiproc workers, spawned servers) inherit the
+# operator's plan without any plumbing.
+if os.environ.get("EGPT_FAULTS"):
+    configure()

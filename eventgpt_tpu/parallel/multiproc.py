@@ -41,12 +41,16 @@ from typing import Dict, List, Optional, Sequence
 _RESULT_TAG = "MPRESULT1"
 
 
-def _free_port() -> int:
+def _reserve_port() -> socket.socket:
+    """Bind an ephemeral port and HOLD the socket (ADVICE r5: closing
+    before the coordinator binds leaves a window where another process
+    claims the port — a spurious bootstrap failure under parallel CI).
+    The caller closes it just before spawning workers; SO_REUSEADDR lets
+    the coordinator rebind the briefly-TIME_WAIT-free port immediately."""
     s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    return s
 
 
 # ---------------------------------------------------------------------------
@@ -95,8 +99,14 @@ def worker_main() -> None:
     # not reach initialize_distributed's autodetection. Scrubbing the spawn
     # env is NOT enough — the axon image's sitecustomize re-injects
     # TPU_WORKER_HOSTNAMES into every fresh interpreter.
+    from eventgpt_tpu import faults
     from eventgpt_tpu.parallel.dist import POD_AUTODETECT_VARS
 
+    # Chaos hook for the process-boundary story: EGPT_FAULTS propagates
+    # through the spawn env, so 'multiproc.worker:n=1' kills the first
+    # worker's bootstrap — the launcher's round-robin poll must surface
+    # it as that rank's failure, not a coordinator deadlock.
+    faults.maybe_fail("multiproc.worker")
     for k in POD_AUTODETECT_VARS:
         os.environ.pop(k, None)
     import jax
@@ -298,7 +308,11 @@ def launch_multiprocess_dryrun(
                          f"{math.prod(mesh_shape)} devices, have "
                          f"{n_processes}x{local_devices}={global_devices}")
 
-    port = _free_port()
+    from eventgpt_tpu import faults
+
+    faults.maybe_fail("multiproc.launch")
+    port_sock = _reserve_port()
+    port = port_sock.getsockname()[1]
     repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     cmd = [sys.executable, "-m", "eventgpt_tpu.parallel.multiproc", "--worker"]
 
@@ -315,6 +329,9 @@ def launch_multiprocess_dryrun(
         # and files let the poll loop below read everything post-mortem.
         procs = []
         logs = []
+        # Release the reserved port at the last possible moment: the
+        # rank-0 worker's coordinator binds it next.
+        port_sock.close()
         for rank in range(n_processes):
             env = _worker_env(os.environ, local_devices)
             env.update(common)

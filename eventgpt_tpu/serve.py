@@ -48,6 +48,7 @@ batch-tiling numerics apply.
 from __future__ import annotations
 
 import functools
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
@@ -57,10 +58,35 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from eventgpt_tpu import faults
 from eventgpt_tpu.config import EventChatConfig
 from eventgpt_tpu.constants import SEQ_BUCKET
 from eventgpt_tpu.models import eventchat, llama as llama_mod
 from eventgpt_tpu.ops.sampling import sample
+
+
+class QueueFullError(RuntimeError):
+    """submit() refused: the admission queue is at ``max_queue``. The HTTP
+    layer maps this to 429 + Retry-After (backpressure, not failure)."""
+
+
+# Terminal request statuses (``ContinuousBatcher.finish_status``). "ok"
+# covers both EOS and budget exhaustion; everything else is a forced
+# finish whose row was freed without burning the remaining budget.
+STATUS_OK = "ok"
+STATUS_DEADLINE = "deadline_exceeded"
+STATUS_CANCELLED = "cancelled"
+STATUS_NAN = "nan_quarantined"
+
+
+def _pixels_key(pixel_values) -> bytes:
+    """Content key of an event-pixel tensor (shape + sha1 of the f32
+    bytes) — the event-block prefix guard's identity check (ADVICE r5
+    medium: token ids alone cannot distinguish two streams)."""
+    import hashlib
+
+    arr = np.ascontiguousarray(np.asarray(pixel_values, np.float32))
+    return str(arr.shape).encode() + hashlib.sha1(arr.tobytes()).digest()
 
 
 def _decode_segment(
@@ -123,7 +149,11 @@ def _decode_segment(
     t, tokens, n_new, done, logits, cache, key = lax.while_loop(
         cond, body, (jnp.int32(0), tokens0, n_new0, done0, logits, cache, key)
     )
-    return tokens, n_new, done, logits, cache, key
+    # Per-row non-finite-logit flag, computed IN-GRAPH (one fused reduce
+    # per segment, no extra host dispatch): the scheduler quarantines a
+    # non-finite row instead of letting NaN logits poison the engine.
+    finite = jnp.isfinite(logits).all(axis=-1)
+    return tokens, n_new, done, finite, logits, cache, key
 
 
 _decode_segment_jit = functools.partial(
@@ -378,7 +408,8 @@ def _get_sharded_decode_segment(
             chunk, eos_token_id, temperature, top_p,
         ),
         donate_argnums=(2,),
-        out_shardings=(toks_sh, b_sh, b_sh, logits_sh, cache_sh, key_sh),
+        out_shardings=(toks_sh, b_sh, b_sh, b_sh, logits_sh, cache_sh,
+                       key_sh),
     )
 
 
@@ -472,6 +503,10 @@ class _Request:
     t_submit: float = 0.0
     t_first: Optional[float] = None
     t_done: Optional[float] = None
+    # Absolute perf_counter deadline (None = no deadline). Enforced both
+    # while queued and between decode segments: an expired row is frozen
+    # and finished with STATUS_DEADLINE instead of burning its budget.
+    deadline: Optional[float] = None
 
 
 class ContinuousBatcher:
@@ -509,6 +544,8 @@ class ContinuousBatcher:
         history_len: int = 2048,
         draft_head=None,
         first_chunk: int = 0,
+        max_queue: int = 0,
+        nan_check: bool = True,
     ):
         if prefill_chunk and (2 * SEQ_BUCKET) % prefill_chunk:
             # A chunk that does not divide the bucket grain would force
@@ -619,6 +656,19 @@ class ContinuousBatcher:
         self.rows: List[Optional[_Request]] = [None] * max_batch
         self.queue: deque[_Request] = deque()
         self.finished: Dict[int, List[int]] = {}
+        # Terminal status per finished rid (STATUS_*): drained by the
+        # serving engine at harvest; bounded for direct batcher users the
+        # same way request_stats is.
+        self.finish_status: Dict[int, str] = {}
+        # 0 = unbounded (library default; the HTTP front end passes its
+        # --max_queue). A bounded queue turns overload into an explicit
+        # QueueFullError at submit instead of unbounded host growth.
+        self.max_queue = int(max_queue)
+        self.nan_check = bool(nan_check)
+        # Live requests carrying a deadline (maintained by submit /
+        # _record_finish): the per-step expiry scan is skipped outright
+        # when zero, so deadline-less traffic pays nothing.
+        self._n_deadlines = 0
         self._next_rid = 0
         self.prefill_chunk = int(prefill_chunk)
         self._pending: Optional[_PendingAdmission] = None
@@ -880,7 +930,11 @@ class ContinuousBatcher:
                 self.params, self.cfg, padded, mask, row_cache, True
             )
         self._prefix = {"ids": ids, "len": p_len, "cache": row_cache,
-                        "bucket": s1p, "has_event": n_ev == 1}
+                        "bucket": s1p, "has_event": n_ev == 1,
+                        # Identity of the prefix's event stream: admissions
+                        # whose pixels differ must NOT reuse this KV.
+                        "pixels_key": (_pixels_key(pixel_values)
+                                       if n_ev == 1 else None)}
         return p_len
 
     def _prefix_suffix_ids(self, req) -> Optional[List[int]]:
@@ -900,6 +954,13 @@ class ContinuousBatcher:
         # The sentinel must live on exactly one side of the split.
         if has_ev == pre["has_event"]:
             return None
+        if pre["has_event"] and req.pixel_values is not None:
+            # Event-block prefix guard (ADVICE r5 medium): the request's
+            # own pixels must BE the prefix's stream, or the cached KV
+            # would silently answer about the wrong stream. Mismatch ->
+            # full prefill of the request's own prompt + pixels.
+            if _pixels_key(req.pixel_values) != pre["pixels_key"]:
+                return None
         return suffix
 
     def _prefix_admit(self, pixel_values, suffix_ids):
@@ -974,10 +1035,23 @@ class ContinuousBatcher:
         return row_cache, last, hidden, prompt_len
 
     def submit(self, input_ids: Sequence[int], pixel_values,
-               max_new_tokens: int = 64) -> int:
+               max_new_tokens: int = 64,
+               deadline_s: Optional[float] = None) -> int:
         """Enqueue one request; raises immediately if it cannot fit, so one
-        oversized request never tears down the serving loop mid-drain."""
+        oversized request never tears down the serving loop mid-drain.
+
+        ``deadline_s``: seconds from now after which the request is
+        finished with ``STATUS_DEADLINE`` (whatever tokens it committed so
+        far are returned) instead of holding a batch row for its full
+        budget. Raises ``QueueFullError`` when the admission queue is at
+        ``max_queue`` (backpressure — the caller should retry later)."""
         from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
+
+        if self.max_queue and len(self.queue) >= self.max_queue:
+            raise QueueFullError(
+                f"admission queue is full ({len(self.queue)}/"
+                f"{self.max_queue} requests queued); retry later"
+            )
 
         ids = list(input_ids)
         n_text = sum(1 for t in ids if t != EVENT_TOKEN_INDEX)
@@ -1000,14 +1074,36 @@ class ContinuousBatcher:
                 f"request does not fit: prompt {prompt_len} + budget "
                 f"{max_new_tokens} exceeds server max_len {self.max_len}"
             )
-        import time
-
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(rid, ids, pixel_values, max_new_tokens)
         req.t_submit = time.perf_counter()
+        if deadline_s is not None:
+            req.deadline = req.t_submit + float(deadline_s)
+            self._n_deadlines += 1
         self.queue.append(req)
         return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a queued or in-flight request: its row is freed (or it
+        leaves the queue / pending admission), whatever tokens it already
+        committed are finished under ``STATUS_CANCELLED``. Returns False
+        when the rid is unknown or already finished."""
+        for req in self.queue:
+            if req.rid == rid:
+                self.queue.remove(req)
+                self._finish_forced(req, STATUS_CANCELLED)
+                return True
+        if self._pending is not None and self._pending.req.rid == rid:
+            p, self._pending = self._pending, None
+            self.rows[p.row] = None  # row stays frozen; cache untouched
+            self._finish_forced(p.req, STATUS_CANCELLED)
+            return True
+        for r, req in enumerate(self.rows):
+            if req is not None and req.rid == rid:
+                self._finish_row(r, status=STATUS_CANCELLED)
+                return True
+        return False
 
     def run_until_drained(self) -> Dict[int, List[int]]:
         while self.queue or any(r is not None for r in self.rows):
@@ -1034,11 +1130,12 @@ class ContinuousBatcher:
     # -- scheduler core ---------------------------------------------------
 
     def step(self) -> None:
-        """One scheduling iteration: admit into free rows (one prefill
-        chunk when a chunked admission is in flight), run one decode
-        segment, harvest finished rows."""
-        import time
-
+        """One scheduling iteration: expire deadlines, admit into free
+        rows (one prefill chunk when a chunked admission is in flight),
+        run one decode segment, harvest finished rows."""
+        faults.maybe_fail("serve.step")
+        faults.maybe_delay("serve.step")
+        self._expire_deadlines()
         t0 = time.perf_counter()
         self._admit()
         dt_admit = time.perf_counter() - t0
@@ -1058,7 +1155,7 @@ class ContinuousBatcher:
             # A fresh admission owes its first token: run the short ramp
             # segment so TTFT is ~first_chunk iterations, not a full chunk.
             chunk = self.first_chunk
-        tokens, new_np, n_new, done = self._segment(
+        tokens, new_np, n_new, done, finite = self._segment(
             jnp.asarray(self.frozen), jnp.asarray(self.n_rem.astype(np.int32)),
             chunk=chunk,
         )
@@ -1067,6 +1164,13 @@ class ContinuousBatcher:
         now = time.perf_counter()
         for r, req in enumerate(self.rows):
             if req is None or self.frozen[r]:
+                continue
+            if finite is not None and not finite[r]:
+                # Non-finite logits poison only this ROW: its segment
+                # tokens (sampled from NaN/inf logits) are discarded, the
+                # row is frozen and the request fails with a structured
+                # status — the batch and the engine keep running.
+                self._finish_row(r, status=STATUS_NAN)
                 continue
             if self.speculative:
                 new = new_np[r, : n_new[r]]
@@ -1080,15 +1184,46 @@ class ContinuousBatcher:
             if done[r] or self.n_rem[r] <= 0:
                 self._finish_row(r)
 
+    def _expire_deadlines(self) -> None:
+        """Forced finish for every request past its deadline: queued ones
+        leave the queue, a pending admission is dropped (its row stays
+        frozen), and active rows are frozen mid-decode — each finished
+        with ``STATUS_DEADLINE`` and its committed-so-far tokens."""
+        if self._n_deadlines <= 0:
+            return  # deadline-less traffic: zero per-step scan cost
+        now = time.perf_counter()
+
+        def expired(req):
+            return req.deadline is not None and now > req.deadline
+
+        if self.queue and any(expired(q) for q in self.queue):
+            keep = deque()
+            for req in self.queue:
+                if expired(req):
+                    self._finish_forced(req, STATUS_DEADLINE)
+                else:
+                    keep.append(req)
+            self.queue = keep
+        if self._pending is not None and expired(self._pending.req):
+            p, self._pending = self._pending, None
+            self.rows[p.row] = None
+            self._finish_forced(p.req, STATUS_DEADLINE)
+        for r, req in enumerate(self.rows):
+            if req is not None and not self.frozen[r] and expired(req):
+                self._finish_row(r, status=STATUS_DEADLINE)
+
     def _segment(self, frozen, n_rem, chunk: Optional[int] = None):
         """Dispatch one decode/spec segment on the resident state. Returns
-        ``(tokens, new_np, n_new, done)`` as host arrays (``tokens`` for
-        the plain path, ``new_np`` the per-row committed window for the
-        speculative path). ``chunk`` defaults to the full segment length;
-        the TTFT ramp passes ``first_chunk`` (each distinct value is its
-        own cached executable). Also the warmup entry point: with every
-        row frozen the while_loop exits at entry — a no-op dispatch that
-        still compiles and caches the segment executable."""
+        ``(tokens, new_np, n_new, done, finite)`` as host arrays
+        (``tokens`` for the plain path, ``new_np`` the per-row committed
+        window for the speculative path; ``finite`` is the per-row
+        non-finite-logit quarantine mask on the plain path, ``None`` on
+        the speculative path whose NaN gate is the admission check).
+        ``chunk`` defaults to the full segment length; the TTFT ramp
+        passes ``first_chunk`` (each distinct value is its own cached
+        executable). Also the warmup entry point: with every row frozen
+        the while_loop exits at entry — a no-op dispatch that still
+        compiles and caches the segment executable."""
         if chunk is None:
             chunk = self.chunk
         if self.speculative:
@@ -1137,6 +1272,7 @@ class ContinuousBatcher:
             self.spec_iterations += int(it_v)
             new_np = np.asarray(new_np)
             tokens = None
+            finite = None
         else:
             if self.mesh is not None:
                 fn = _get_sharded_decode_segment(
@@ -1145,27 +1281,47 @@ class ContinuousBatcher:
                     self._cache_flat_sh, self._cache_treedef,
                     self._logits_sh, self._toks_sh, self._b_sh, self._key_sh,
                 )
-                tokens, n_new, done, self.logits, self.cache, self.key = fn(
+                (tokens, n_new, done, fin, self.logits, self.cache,
+                 self.key) = fn(
                     self.params, self.logits, self.cache, self.key,
                     frozen, n_rem,
                 )
             else:
-                tokens, n_new, done, self.logits, self.cache, self.key = (
+                (tokens, n_new, done, fin, self.logits, self.cache,
+                 self.key) = (
                     _decode_segment_jit(
                         self.params, self.cfg, self.logits, self.cache,
                         self.key, frozen, n_rem, chunk, int(self.eos),
                         self.temperature, self.top_p,
                     )
                 )
-            tokens, n_new, done = jax.device_get((tokens, n_new, done))
+            # The quarantine mask is computed in-graph and rides the same
+            # device_get as the segment outputs — no extra dispatch or
+            # round trip on the hot path.
+            tokens, n_new, done, finite = jax.device_get(
+                (tokens, n_new, done, fin))
+            finite = np.asarray(finite) if self.nan_check else None
             tokens = np.asarray(tokens)
             new_np = None
-        return tokens, new_np, np.asarray(n_new), np.asarray(done)
+        return (tokens, new_np, np.asarray(n_new), np.asarray(done),
+                finite)
 
-    def _finish_row(self, r: int) -> None:
-        import time
-
+    def _finish_row(self, r: int, status: str = STATUS_OK) -> None:
         req = self.rows[r]
+        self.rows[r] = None
+        self.frozen[r] = True
+        self.n_rem[r] = 0
+        self._record_finish(req, status)
+
+    def _finish_forced(self, req: _Request, status: str) -> None:
+        """Terminal bookkeeping for a request that never held (or no
+        longer holds) a batch row — expired in the queue, cancelled, or
+        quarantined at admission."""
+        self._record_finish(req, status)
+
+    def _record_finish(self, req: _Request, status: str) -> None:
+        if req.deadline is not None:
+            self._n_deadlines -= 1
         ids = req.tokens
         if (self.eos_token_id is not None and ids
                 and ids[-1] == self.eos_token_id):
@@ -1173,18 +1329,21 @@ class ContinuousBatcher:
         req.t_done = time.perf_counter()
         # Bounded: a long-lived server must not grow host state per
         # request forever (oldest-first eviction; dicts are
-        # insertion-ordered).
+        # insertion-ordered). finish_status is drained at harvest by the
+        # engine; the same bound protects direct batcher users.
         while len(self.request_stats) >= 8192:
             self.request_stats.pop(next(iter(self.request_stats)))
+        while len(self.finish_status) >= 8192:
+            self.finish_status.pop(next(iter(self.finish_status)))
         self.request_stats[req.rid] = {
             "ttft_s": (req.t_first if req.t_first is not None
                        else req.t_done) - req.t_submit,
             "latency_s": req.t_done - req.t_submit,
         }
-        self._history_append(ids)
+        if status == STATUS_OK:
+            self._history_append(ids)
         self.finished[req.rid] = ids
-        self.rows[r] = None
-        self.frozen[r] = True
+        self.finish_status[req.rid] = status
 
     def _history_append(self, toks) -> None:
         """Append committed/prompt text to the chronological history ring
@@ -1205,6 +1364,8 @@ class ContinuousBatcher:
     def _admit(self) -> None:
         from eventgpt_tpu.models.eventchat import _prefill_jit, _prefill_sharded
 
+        faults.maybe_fail("serve.admit")
+        faults.maybe_delay("serve.admit")
         if self._pending is not None:
             self._advance_pending()
         while (self._pending is None and self.queue
@@ -1349,6 +1510,16 @@ class ContinuousBatcher:
     def _finish_admission(self, req, row, prompt_len, row_cache,
                           row_logits, row_hidden=None) -> None:
         """Insert the prefilled row into the shared cache + activate it."""
+        if self.nan_check and not bool(
+                np.isfinite(np.asarray(jax.device_get(row_logits))).all()):
+            # Prefill produced non-finite logits: quarantine the REQUEST
+            # before it touches the shared cache (the speculative path's
+            # only NaN gate — it commits the prefill sample at admission
+            # and carries no per-segment logits to check).
+            self.rows[row] = None
+            self.frozen[row] = True
+            self._finish_forced(req, STATUS_NAN)
+            return
         if self.mesh is not None:
             admit = _get_sharded_admit(
                 self._cache_flat_sh, self._cache_treedef, self._logits_sh

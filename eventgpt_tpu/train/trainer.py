@@ -21,6 +21,7 @@ import numpy as np
 
 from eventgpt_tpu import checkpoint as ckpt
 from eventgpt_tpu import constants
+from eventgpt_tpu import faults
 from eventgpt_tpu.config import EventChatConfig, MeshConfig
 from eventgpt_tpu.parallel import best_mesh_config, make_mesh, shard_params
 from eventgpt_tpu.parallel.dist import is_primary
@@ -508,6 +509,11 @@ class Trainer:
             diverged = False
             try:
                 for host_batch in it:
+                    # Micro-batch-boundary fault site: a chaos test can
+                    # kill or slow any step deterministically and assert
+                    # the preemption/divergence/heartbeat story holds.
+                    faults.maybe_fail("train.step")
+                    faults.maybe_delay("train.step")
                     # Local flag check is free; the cross-host AGREEMENT collective
                     # (globally_requested) only runs every preempt_poll_micros so
                     # multi-host runs don't fence async dispatch per micro-batch.

@@ -1,0 +1,252 @@
+"""Deterministic fault injection (``eventgpt_tpu/faults.py``) and the
+request-lifecycle hardening it exercises in ``ContinuousBatcher``:
+per-request deadlines (queued AND mid-decode), the bounded admission
+queue, ``cancel()``, and non-finite-logit row quarantine. Fast tier:
+tiny config, CPU, small budgets — these are the failure paths the
+serving stack claims to survive, so they run on every iteration."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgpt_tpu import faults
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.models import eventchat
+from eventgpt_tpu.serve import ContinuousBatcher, QueueFullError
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test starts and ends with injection disarmed (module-global
+    registry: a leaked plan would poison unrelated tests)."""
+    faults.disable()
+    yield
+    faults.disable()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(5))
+    return cfg, params
+
+
+def _pv(cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(cfg.num_event_frames, 3, cfg.vision.image_size,
+                            cfg.vision.image_size)).astype(np.float32)
+
+
+def _batcher(tiny, **kw):
+    cfg, params = tiny
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 256)
+    kw.setdefault("chunk", 2)
+    kw.setdefault("eos_token_id", None)
+    return ContinuousBatcher(params, cfg, **kw)
+
+
+# -- registry semantics ----------------------------------------------------
+
+
+def test_nth_fires_exactly_once_on_that_call():
+    faults.configure("x:n=3")
+    fired = []
+    for i in range(1, 7):
+        try:
+            faults.maybe_fail("x")
+        except faults.InjectedFault:
+            fired.append(i)
+    assert fired == [3]
+    assert faults.stats() == {"x": {"calls": 6, "fires": 1}}
+
+
+def test_every_with_times_cap():
+    faults.configure("y:every=2,times=2")
+    fired = []
+    for i in range(1, 9):
+        try:
+            faults.maybe_fail("y")
+        except faults.InjectedFault:
+            fired.append(i)
+    assert fired == [2, 4]  # every 2nd call, capped at 2 fires
+
+
+def test_probability_is_seed_deterministic():
+    def pattern(seed):
+        faults.configure("z:p=0.5", seed=seed)
+        out = []
+        for _ in range(32):
+            try:
+                faults.maybe_fail("z")
+                out.append(0)
+            except faults.InjectedFault:
+                out.append(1)
+        return out
+
+    a, b = pattern(7), pattern(7)
+    assert a == b                      # same seed -> same firing sequence
+    assert 0 < sum(a) < 32             # and it actually mixes
+    assert pattern(8) != a             # different seed -> different plan
+
+
+def test_delay_site_sleeps_and_never_raises():
+    faults.configure("slow:delay=0.02,times=1")
+    faults.maybe_fail("slow")          # delay rules never raise
+    t0 = time.perf_counter()
+    assert faults.maybe_delay("slow") == pytest.approx(0.02)
+    assert time.perf_counter() - t0 >= 0.02
+    assert faults.maybe_delay("slow") == 0.0  # times cap consumed
+
+
+def test_unknown_site_and_disabled_are_noops():
+    faults.configure("a:n=1")
+    faults.maybe_fail("other.site")    # not in the plan
+    assert faults.maybe_delay("other.site") == 0.0
+    faults.disable()
+    assert not faults.enabled()
+    assert faults.stats() == {}
+    for _ in range(3):
+        faults.maybe_fail("a")         # disarmed: never raises
+
+
+def test_env_var_configures(monkeypatch):
+    monkeypatch.setenv("EGPT_FAULTS", "envsite:n=1")
+    monkeypatch.setenv("EGPT_FAULTS_SEED", "3")
+    faults.configure()
+    assert faults.enabled()
+    with pytest.raises(faults.InjectedFault):
+        faults.maybe_fail("envsite")
+
+
+def test_bad_specs_raise():
+    with pytest.raises(ValueError, match="site:key=value"):
+        faults.configure("nocolon")
+    with pytest.raises(ValueError, match="unknown fault key"):
+        faults.configure("x:frequency=2")
+
+
+# -- batcher chaos ---------------------------------------------------------
+
+
+def test_step_fault_site_reaches_caller_and_recovers(tiny):
+    cfg, params = tiny
+    srv = _batcher(tiny, max_batch=1)
+    rid = srv.submit([1, -200, 5], _pv(cfg), 6)
+    faults.configure("serve.step:n=2")
+    srv.step()                               # call 1: clean (admits)
+    with pytest.raises(faults.InjectedFault, match="serve.step"):
+        srv.step()                           # call 2: injected
+    out = srv.run_until_drained()            # n= fires once; rest clean
+    assert len(out[rid]) == 6
+    assert srv.finish_status[rid] == "ok"
+
+
+def test_bounded_queue_rejects_at_submit(tiny):
+    cfg, params = tiny
+    srv = _batcher(tiny, max_batch=1, max_queue=2)
+    pv = _pv(cfg)
+    rids = [srv.submit([1, -200, 5], pv, 3) for _ in range(2)]
+    with pytest.raises(QueueFullError, match="2/2"):
+        srv.submit([1, -200, 5], pv, 3)
+    out = srv.run_until_drained()            # bound rejects, never corrupts
+    assert all(len(out[r]) == 3 for r in rids)
+
+
+def test_deadline_expires_while_queued(tiny):
+    cfg, params = tiny
+    srv = _batcher(tiny, max_batch=1)
+    late = srv.submit([1, -200, 5], _pv(cfg), 8, deadline_s=-0.001)
+    ok = srv.submit([1, -200, 7], _pv(cfg, 1), 4)
+    out = srv.run_until_drained()
+    assert out[late] == [] and srv.finish_status[late] == "deadline_exceeded"
+    assert len(out[ok]) == 4 and srv.finish_status[ok] == "ok"
+    assert srv.request_stats[late]["latency_s"] >= 0
+
+
+def test_deadline_expires_mid_decode_and_frees_the_row(tiny):
+    """An expired ACTIVE row is frozen with its committed-so-far tokens
+    (status deadline_exceeded) instead of burning its 64-token budget,
+    and the freed row immediately serves the next request."""
+    cfg, params = tiny
+    srv = _batcher(tiny, max_batch=1)
+    rid = srv.submit([1, -200, 5], _pv(cfg), 64, deadline_s=30.0)
+    srv.step()                               # admitted + one 2-token segment
+    req = next(r for r in srv.rows if r is not None)
+    assert req.rid == rid and len(req.tokens) == 2
+    req.deadline = time.perf_counter() - 1.0  # deterministic expiry
+    follow = srv.submit([1, -200, 7], _pv(cfg, 1), 3)
+    out = srv.run_until_drained()
+    assert srv.finish_status[rid] == "deadline_exceeded"
+    assert out[rid] == req.tokens and 2 <= len(out[rid]) < 64
+    assert len(out[follow]) == 3 and srv.finish_status[follow] == "ok"
+
+
+def test_cancel_queued_and_active(tiny):
+    cfg, params = tiny
+    srv = _batcher(tiny)
+    a = srv.submit([1, -200, 5], _pv(cfg), 30)
+    b = srv.submit([1, -200, 7], _pv(cfg, 1), 30)
+    c = srv.submit([1, -200, 9], _pv(cfg, 2), 4)  # queued (2 rows busy)
+    srv.step()
+    assert srv.cancel(c) and srv.finish_status[c] == "cancelled"
+    assert srv.cancel(a) and srv.finish_status[a] == "cancelled"
+    assert srv.cancel(a) is False                 # already finished
+    assert srv.cancel(10**6) is False             # unknown rid
+    out = srv.run_until_drained()
+    assert out[c] == []
+    assert len(out[a]) < 30                       # partial commit returned
+    assert len(out[b]) == 30 and srv.finish_status[b] == "ok"
+
+
+def test_nan_pixels_quarantined_at_admission(tiny):
+    """Non-finite prefill logits fail the REQUEST, not the engine: the
+    poisoned request returns [] under nan_quarantined while a healthy
+    one admitted alongside completes."""
+    cfg, params = tiny
+    pv_nan = _pv(cfg).copy()
+    pv_nan[0, 0, 0, 0] = np.nan
+    srv = _batcher(tiny)
+    bad = srv.submit([1, -200, 5], pv_nan, 8)
+    good = srv.submit([1, -200, 7], _pv(cfg, 1), 6)
+    out = srv.run_until_drained()
+    assert out[bad] == [] and srv.finish_status[bad] == "nan_quarantined"
+    assert len(out[good]) == 6 and srv.finish_status[good] == "ok"
+
+
+def test_nan_mid_decode_quarantines_row_not_batch(tiny):
+    """NaN poisoning one row's attended KV makes ITS logits non-finite;
+    the quarantine freezes that row only — the co-resident row keeps
+    decoding and the engine survives (the pre-hardening behavior was a
+    poisoned engine: every later request read garbage)."""
+    cfg, params = tiny
+    srv = _batcher(tiny)
+    a = srv.submit([1, -200, 5], _pv(cfg), 40)
+    b = srv.submit([1, -200, 7], _pv(cfg, 1), 6)
+    srv.step()
+    ra = next(r for r, req in enumerate(srv.rows) if req and req.rid == a)
+    srv.cache = {**srv.cache,
+                 "v": srv.cache["v"].at[:, ra, 0].set(jnp.nan)}
+    out = srv.run_until_drained()
+    assert srv.finish_status[a] == "nan_quarantined"
+    assert len(out[a]) < 40                       # budget not burned
+    assert len(out[b]) == 6 and srv.finish_status[b] == "ok"
+
+
+def test_forced_finish_row_recycles_cleanly(tiny):
+    """After deadline/cancel/quarantine forced finishes, the freed rows
+    serve fresh requests with clean state (no stale frozen lengths or
+    budgets leaking into the next admission)."""
+    cfg, params = tiny
+    pv_nan = _pv(cfg).copy()
+    pv_nan[:] = np.nan
+    srv = _batcher(tiny, max_batch=1)
+    srv.submit([1, -200, 5], pv_nan, 8)           # quarantined at admission
+    expired = srv.submit([1, -200, 7], _pv(cfg, 1), 8, deadline_s=-1.0)
+    fresh = srv.submit([1, -200, 9], _pv(cfg, 2), 5)
+    out = srv.run_until_drained()
+    assert srv.finish_status[expired] == "deadline_exceeded"
+    assert len(out[fresh]) == 5 and srv.finish_status[fresh] == "ok"

@@ -440,6 +440,58 @@ def test_prefix_takes_precedence_over_chunked_prefill(tiny):
         assert out[rid] == _oneshot(params, cfg, ids, _pv(cfg, s), b), rid
 
 
+def test_event_prefix_wrong_stream_falls_back_to_full_prefill(tiny):
+    """ADVICE r5 medium: with a prefix THROUGH the event block, a request
+    whose prompt ids match but whose pixels are a DIFFERENT stream must
+    get answers computed against its own stream (full prefill fallback),
+    not the prefix's cached KV; matching pixels still take the cheap
+    prefix path. Both must equal one-shot generate exactly."""
+    cfg, params = tiny
+    pv_a, pv_b = _pv(cfg, 4), _pv(cfg, 7)
+    head = [1, 5, -200, 7]
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None)
+    srv.set_prefix(head, pixel_values=pv_a)
+    ids = head + [9, 9, 12]
+    same = srv.submit(ids, pv_a, 8)
+    other = srv.submit(ids, pv_b, 8)
+    out = srv.run_until_drained()
+    assert out[same] == _oneshot(params, cfg, ids, pv_a, 8)
+    assert out[other] == _oneshot(params, cfg, ids, pv_b, 8)
+    # The guard is observable: different streams, different answers
+    # (pv_b used to silently inherit pv_a's KV and match `same`).
+    assert out[other] != out[same]
+
+
+def test_deadline_and_cancel_preserve_batch_exactness(tiny):
+    """Forced finishes (deadline expiry, cancel) free rows mid-flight;
+    the surviving and subsequent requests must still commit their exact
+    one-shot greedy chains — scheduling-only intervention, no numeric
+    contamination from the freed rows."""
+    import time as _time
+
+    cfg, params = tiny
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=3,
+                            eos_token_id=None)
+    doomed = srv.submit([1, 5, -200, 9], _pv(cfg, 0), 12, deadline_s=60.0)
+    keeper = srv.submit([1, -200, 7, 7], _pv(cfg, 1), 9)
+    srv.step()
+    req = next(r for r in srv.rows if r is not None and r.rid == doomed)
+    req.deadline = _time.perf_counter() - 1.0
+    late = srv.submit([3, -200, 11, 4], _pv(cfg, 2), 6)
+    cancel_me = srv.submit([3, -200, 11], _pv(cfg, 3), 6)
+    assert srv.cancel(cancel_me)  # still queued: cancelled before a row
+    out = srv.run_until_drained()
+    assert srv.finish_status[doomed] == "deadline_exceeded"
+    assert srv.finish_status[cancel_me] == "cancelled"
+    assert out[cancel_me] == []
+    want_doomed = _oneshot(params, cfg, [1, 5, -200, 9], _pv(cfg, 0), 12)
+    assert out[doomed] == want_doomed[: len(out[doomed])]  # exact prefix
+    assert len(out[doomed]) < 12
+    assert out[keeper] == _oneshot(params, cfg, [1, -200, 7, 7], _pv(cfg, 1), 9)
+    assert out[late] == _oneshot(params, cfg, [3, -200, 11, 4], _pv(cfg, 2), 6)
+
+
 def test_first_chunk_ramp_with_eos_in_ramp_segment(tiny):
     """A row whose EOS lands inside the short ramp segment freezes there
     and matches the eos-stopped one-shot chain."""

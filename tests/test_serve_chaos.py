@@ -1,0 +1,321 @@
+"""Chaos tests for the serving engine + HTTP front end (``cli/serve.py``)
+under deterministic fault injection: scheduler faults restart the
+scheduler thread and trip the circuit breaker (``/health`` -> degraded,
+POSTs 503, half-open recovery), deadline expiry surfaces as HTTP 504,
+queue overload as 429 + Retry-After, NaN quarantine as a structured
+error, ``POST /cancel`` works, and the serving heartbeat file matches
+the trainer's watchdog convention. Fast tier: tiny config, CPU, tiny
+budgets — the whole point of ISSUE 1 is that every one of these paths
+runs on every iteration, not only in slow e2e sweeps."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from eventgpt_tpu import faults
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.models import eventchat
+from eventgpt_tpu.serve import ContinuousBatcher, QueueFullError
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.disable()
+    yield
+    faults.disable()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _pv(cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(cfg.num_event_frames, 3, cfg.vision.image_size,
+                            cfg.vision.image_size)).astype(np.float32)
+
+
+def _event_npy_b64(tmp_path, n=4000):
+    """A synthetic structured-array event file (the native stream layout)
+    encoded for the ``event_b64`` upload path — the fast tier must not
+    depend on the reference samples existing."""
+    import base64
+
+    from eventgpt_tpu.ops.raster import STREAM_DTYPE
+
+    rng = np.random.default_rng(0)
+    arr = np.zeros(n, dtype=STREAM_DTYPE)
+    arr["x"] = rng.integers(0, 64, n)
+    arr["y"] = rng.integers(0, 48, n)
+    arr["t"] = np.sort(rng.integers(0, 50_000, n)).astype(np.uint64)
+    arr["p"] = rng.integers(0, 2, n)
+    path = os.path.join(str(tmp_path), "events.npy")
+    np.save(path, arr)
+    with open(path, "rb") as f:
+        return base64.b64encode(f.read()).decode()
+
+
+def _engine(tiny, **kw):
+    from eventgpt_tpu.cli.serve import ServingEngine
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+
+    cfg, params = tiny
+    bkw = {k: kw.pop(k) for k in ("max_queue", "max_len") if k in kw}
+    bkw.setdefault("max_len", 256)
+    srv = ContinuousBatcher(params, cfg, max_batch=1, chunk=2,
+                            eos_token_id=None, **bkw)
+    return ServingEngine(srv, load_tokenizer("byte"), **kw)
+
+
+def _serve_http(engine, cfg):
+    from http.server import ThreadingHTTPServer
+
+    from eventgpt_tpu.cli.serve import make_handler
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                make_handler(engine, cfg))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def test_transient_fault_restarts_scheduler_and_recovers(tiny):
+    """One mid-decode scheduler fault (below the breaker threshold): the
+    in-flight request fails CLEANLY with the fault, the scheduler thread
+    restarts, and the very next request completes — the pre-hardening
+    behavior was a permanently dead engine."""
+    cfg, params = tiny
+    faults.configure("serve.step:n=2")  # step 1 admits+decodes, 2 faults
+    eng = _engine(tiny, breaker_threshold=3, breaker_cooldown_s=0.5)
+    try:
+        rid = eng.submit("What is happening?", _pv(cfg), 8)
+        with pytest.raises(RuntimeError, match="InjectedFault"):
+            eng.result(rid, timeout=120)
+        assert eng.n_faults == 1 and not eng.breaker_open()
+        rid2 = eng.submit("Again?", _pv(cfg), 6)
+        assert len(eng.result(rid2, timeout=120)) == 6
+        assert eng.n_restarts >= 1
+        assert eng.fault is None  # clean step closed the streak
+    finally:
+        eng.shutdown()
+
+
+def test_breaker_trips_degrades_health_then_half_open_recovers(tiny):
+    """The acceptance scenario: consecutive scheduler faults trip the
+    breaker -> /health says degraded (503) and POSTs are refused -> the
+    cooldown's half-open probe admits traffic -> a clean request closes
+    the breaker and /health returns to ok."""
+    cfg, params = tiny
+    faults.configure("serve.step:every=1,times=2")  # exactly 2 faults
+    eng = _engine(tiny, breaker_threshold=2, breaker_cooldown_s=1.0)
+    httpd, url = _serve_http(eng, cfg)
+    try:
+        rid = eng.submit("trip?", _pv(cfg), 6)
+        with pytest.raises(RuntimeError, match="down|InjectedFault"):
+            eng.result(rid, timeout=120)  # trip sweeps the queue
+        assert eng.breaker_open()
+        with urllib.request.urlopen(url + "/health", timeout=30) as r:
+            pass
+        raise AssertionError("degraded health must be 503")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        body = json.loads(e.read())
+        assert body["status"] == "degraded"
+        assert "InjectedFault" in body["error"]
+    finally:
+        pass
+    try:
+        with pytest.raises(RuntimeError, match="down"):
+            eng.submit("refused?", _pv(cfg), 4)
+        deadline = time.time() + 10
+        while eng.breaker_open() and time.time() < deadline:
+            time.sleep(0.05)
+        assert not eng.breaker_open()  # cooldown elapsed: half-open
+        rid = eng.submit("recovered?", _pv(cfg), 5)  # injection exhausted
+        assert len(eng.result(rid, timeout=120)) == 5
+        with urllib.request.urlopen(url + "/health", timeout=30) as r:
+            h = json.loads(r.read())
+        assert h["status"] == "ok" and h["restarts"] >= 1
+        assert eng.stats()["faults"] == 2
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        eng.shutdown()
+
+
+def test_http_deadline_expiry_is_504(tiny, tmp_path):
+    cfg, params = tiny
+    eng = _engine(tiny, max_len=512)
+    httpd, url = _serve_http(eng, cfg)
+    try:
+        b64 = _event_npy_b64(tmp_path)
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            json.dumps({"query": "too slow?", "event_b64": b64,
+                        "max_new_tokens": 64,
+                        "deadline_s": 1e-4}).encode(),
+            {"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=120)
+        assert e.value.code == 504
+        body = json.loads(e.value.read())
+        assert body["error"] == "deadline_exceeded"
+        assert body["status"] == "deadline_exceeded"
+        # The engine survived the expiry: a request with headroom works.
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            json.dumps({"query": "ok?", "event_b64": b64,
+                        "max_new_tokens": 4,
+                        "deadline_s": 300.0}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())
+        assert out["status"] == "ok" and out["tokens"] == 4
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        eng.shutdown()
+
+
+def test_http_queue_full_is_429_with_retry_after(tiny, tmp_path):
+    cfg, params = tiny
+    eng = _engine(tiny, max_queue=4)
+    httpd, url = _serve_http(eng, cfg)
+
+    def full(*a, **kw):
+        raise QueueFullError("admission queue is full (4/4)")
+
+    try:
+        # Force the bound deterministically (filling a live queue under a
+        # running scheduler is a race; the batcher-level bound has its own
+        # deterministic test in test_faults.py).
+        eng.batcher.submit = full
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            json.dumps({"query": "busy?",
+                        "event_b64": _event_npy_b64(tmp_path)}).encode(),
+            {"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=60)
+        assert e.value.code == 429
+        assert e.value.headers.get("Retry-After") == "1"
+        assert "full" in json.loads(e.value.read())["error"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        eng.shutdown()
+
+
+def test_http_cancel_route_and_engine_cancel(tiny, tmp_path):
+    cfg, params = tiny
+    faults.configure("serve.step:delay=0.2")  # slow steps: a cancel window
+    eng = _engine(tiny, max_len=512)
+    httpd, url = _serve_http(eng, cfg)
+    try:
+        # Unknown rid: clean false, not an error.
+        req = urllib.request.Request(
+            url + "/cancel", json.dumps({"rid": 10**6}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read()) == {"rid": 10**6, "cancelled": False}
+        # Bad payload: 400.
+        req = urllib.request.Request(
+            url + "/cancel", b'{"nope": 1}',
+            {"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 400
+        # Cancel a live request mid-decode through the engine API; its
+        # waiter gets the partial answer under status "cancelled".
+        rid = eng.submit("cancel me?", _pv(cfg), 200)
+        results = {}
+
+        def wait():
+            try:
+                results["toks"] = eng.result(rid, timeout=120)
+            except Exception as e:  # pragma: no cover - surfaced below
+                results["err"] = e
+
+        t = threading.Thread(target=wait)
+        t.start()
+        deadline = time.time() + 30
+        while time.time() < deadline and not eng.cancel(rid):
+            time.sleep(0.02)
+        t.join(timeout=120)
+        assert "toks" in results, results.get("err")
+        assert len(results["toks"]) < 200
+        assert eng.status(rid) == "cancelled"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        eng.shutdown()
+
+
+def test_nan_quarantine_returns_structured_error(tiny):
+    cfg, params = tiny
+    eng = _engine(tiny)
+    try:
+        pv = _pv(cfg).copy()
+        pv[:] = np.nan
+        rid = eng.submit("poisoned?", pv, 8)
+        toks = eng.result(rid, timeout=120)
+        assert toks == [] and eng.status(rid) == "nan_quarantined"
+        rid2 = eng.submit("fine?", _pv(cfg), 4)
+        assert len(eng.result(rid2, timeout=120)) == 4
+        assert eng.status(rid2) == "ok"
+    finally:
+        eng.shutdown()
+
+
+def test_event_prefix_guard_rejects_wrong_stream(tiny):
+    """ADVICE r5 medium: an event-block prefix must not serve a request
+    whose OWN pixels differ from the prefix's stream — token ids alone
+    cannot tell two streams apart. Matching pixels (or none at all) keep
+    the cheap prefix path; a mismatch falls back to full prefill."""
+    cfg, params = tiny
+    srv = ContinuousBatcher(params, cfg, max_batch=1, max_len=256, chunk=2,
+                            eos_token_id=None)
+    head = [1, 5, -200, 7]
+    pv_a, pv_b = _pv(cfg, 1), _pv(cfg, 2)
+    srv.set_prefix(head, pixel_values=pv_a)
+
+    class Req:
+        input_ids = head + [9, 9]
+
+    Req.pixel_values = pv_a
+    assert srv._prefix_suffix_ids(Req) == [9, 9]      # same stream: reuse
+    Req.pixel_values = None
+    assert srv._prefix_suffix_ids(Req) == [9, 9]      # session traffic
+    Req.pixel_values = pv_b
+    assert srv._prefix_suffix_ids(Req) is None        # wrong stream: full
+    Req.pixel_values = pv_a.astype(np.float64) + 0.0  # dtype-insensitive
+    assert srv._prefix_suffix_ids(Req) == [9, 9]
+
+
+def test_serving_heartbeat_matches_trainer_convention(tiny, tmp_path):
+    from eventgpt_tpu.train.resilience import Heartbeat
+
+    cfg, params = tiny
+    eng = _engine(tiny, heartbeat_dir=str(tmp_path),
+                  heartbeat_interval_s=0.05)
+    try:
+        rid = eng.submit("alive?", _pv(cfg), 6)
+        eng.result(rid, timeout=120)
+        deadline = time.time() + 30
+        while time.time() < deadline and Heartbeat.read(str(tmp_path)) is None:
+            time.sleep(0.05)
+        rec = Heartbeat.read(str(tmp_path))
+        assert rec is not None and rec["status"] == "ok"
+        assert rec["step"] >= 1 and rec["faults"] == 0
+        assert not Heartbeat.is_stale(str(tmp_path), timeout_s=600)
+    finally:
+        eng.shutdown()

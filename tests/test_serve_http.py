@@ -386,6 +386,27 @@ def test_stream_restart_event_on_detokenizer_rewrite():
         engine.shutdown()
 
 
+def test_deadline_exceeded_is_504_with_partial_answer(server):
+    """A payload deadline_s the server cannot meet returns HTTP 504 with
+    the structured deadline_exceeded status (ISSUE 1: expired requests
+    must not hold a batch row for their full budget) — and the server
+    keeps serving normally afterwards."""
+    url, _ = server
+    req = urllib.request.Request(
+        url + "/v1/generate",
+        json.dumps({"query": "Too slow?", "event_path": "sample1.npy",
+                    "max_new_tokens": 32, "deadline_s": 1e-4}).encode(),
+        {"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=120)
+    assert e.value.code == 504
+    body = json.loads(e.value.read())
+    assert body["error"] == "deadline_exceeded"
+    follow = _post(url, {"query": "Still here?", "event_path": "sample1.npy",
+                         "max_new_tokens": 4})
+    assert follow["tokens"] == 4 and follow["status"] == "ok"
+
+
 def test_warmup_after_admission_raises(server):
     """The batcher's warmup precondition: never on live rows."""
     _, engine = server
