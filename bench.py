@@ -411,6 +411,7 @@ def run_serve(args):
         speculative=args.serve_spec,
         prefill_chunk=args.serve_prefill_chunk,
         first_chunk=args.serve_first_chunk or 0,
+        pipeline=bool(args.serve_pipeline),
     )
     if args.serve_prefix:
         # Session-style shared prefix: system text + the event block
@@ -453,6 +454,18 @@ def run_serve(args):
         "latency_p99_s": round(float(np.percentile(lats, 99)), 3),
         "first_chunk": args.serve_first_chunk or 0,
         "prefix_reuse": bool(args.serve_prefix),
+        # Pipelined-scheduler overlap story (host-observable; definitions
+        # in PERFORMANCE.md "Pipelined scheduling"): host_gap_s is the
+        # host scheduler time between segments, device_segment_s the time
+        # the host actually BLOCKED on the device, overlap_ratio the
+        # fraction of host work hidden behind in-flight segments. The
+        # synchronous path (--serve_pipeline 0) measures ~0 overlap by
+        # construction — that difference IS the win being recorded.
+        "pipeline": bool(args.serve_pipeline),
+        "segments": srv.seg_count,
+        "host_gap_s": round(srv.host_gap_s, 3),
+        "device_segment_s": round(srv.device_segment_s, 3),
+        "overlap_ratio": round(srv.overlap_ratio(), 3),
         "admission_stall_s": round(srv.admission_s, 3),
         "admission_max_stall_s": round(srv.admission_max_s, 3),
         "first_request_s": round(t_first_req, 3),
@@ -676,7 +689,11 @@ def _train_flops_per_step(cfg, batch: int, seq: int) -> dict:
         the full-training 2x. Attention bwd needs dV, dA, dQ, dK — four
         matmuls vs the forward's two -> attention bwd = 2x attention fwd.
       * CLIP tower: forward only — stage 2 takes no gradient through it
-        (the projector is the first trainable node on that path).
+        (the projector is the first trainable node on that path) —
+        matmuls PLUS the attention score/AV term (ADVICE r5: 2 matmuls
+        * 2 FLOP/MAC * L * T^2 * h over T = 577 tokens per frame,
+        bidirectional so no causal halving; ~0.3 TFLOP/step at the 7B
+        best point — omitting it understated CLIP by ~9%).
       * remat recompute is NOT counted (standard MFU counts model FLOPs;
         the recompute shows up as lower MFU, which is the point).
     """
@@ -694,16 +711,21 @@ def _train_flops_per_step(cfg, batch: int, seq: int) -> dict:
     # (scores + AV = 2 matmuls) * causal 1/2 — written out so the factors
     # are auditable: 2 FLOP/MAC * 2 matmuls * 1/2 causal = 2.
     vc = cfg.vision
-    clip_tokens = batch * cfg.num_event_frames * (
-        (vc.image_size // vc.patch_size) ** 2 + 1)
+    clip_seq = (vc.image_size // vc.patch_size) ** 2 + 1  # 577 at ViT-L/336
+    n_frames = batch * cfg.num_event_frames
+    clip_tokens = n_frames * clip_seq
     n_clip = vc.num_layers * (4 * vc.hidden_size ** 2
                               + 2 * vc.hidden_size * vc.intermediate_size)
-    clip_fwd = 2.0 * n_clip * clip_tokens
+    # Attention score/AV term: 2 FLOP/MAC * 2 matmuls * L * T^2 * h per
+    # frame, no causal halving (the vision tower is bidirectional).
+    clip_attn_fwd = 2.0 * 2.0 * vc.num_layers * clip_seq * clip_seq \
+        * vc.hidden_size * n_frames
+    clip_fwd = 2.0 * n_clip * clip_tokens + clip_attn_fwd
     llama_fwd = llama_mm_fwd + llama_attn_fwd
     # fwd + dgrad-only matmul bwd (1x) + attention bwd (2x attn fwd):
     total = 2.0 * llama_mm_fwd + 3.0 * llama_attn_fwd + clip_fwd
     return {"total": total, "llama_fwd": llama_fwd, "clip_fwd": clip_fwd,
-            "n_llama_mm_params": n_mm}
+            "clip_attn_fwd": clip_attn_fwd, "n_llama_mm_params": n_mm}
 
 
 def run_train(args):
@@ -940,7 +962,8 @@ def run_all(args):
         record["serve_aggregate_tok_s"] = sv["value"]
         for k in ("ttft_p50_s", "ttft_p99_s", "latency_p50_s",
                   "latency_p99_s", "admission_stall_s", "first_request_s",
-                  "warmup_s"):
+                  "warmup_s", "host_gap_s", "device_segment_s",
+                  "overlap_ratio"):
             record[f"serve_{k}"] = sv[k]
     except Exception as e:
         sys.stderr.write(f"serve leg failed: {e}\n")
@@ -1019,6 +1042,11 @@ def main() -> None:
                    help="mode=serve: 1 = set a shared system+event prefix "
                         "(set_prefix) so admissions prefill only the query "
                         "tail")
+    p.add_argument("--serve_pipeline", type=int, default=1,
+                   help="mode=serve: 1 (default) = pipelined scheduler "
+                        "(segment N+1 dispatched from device-resident "
+                        "state while the host harvests N); 0 = the "
+                        "synchronous escape hatch, for A/B runs")
     p.add_argument("--preset", default="auto", choices=["auto", "7b", "13b", "tiny"])
     # Reference run shape: inference.py:19 max_new_tokens=512.
     p.add_argument("--decode_tokens", type=int, default=512)

@@ -165,6 +165,20 @@ class ServingEngine:
             self._wake.set()
         return ok
 
+    def set_prefix(self, prefix_prompt: str, pixels=None) -> int:
+        """Install a shared-prefix KV seed (``ContinuousBatcher.set_prefix``)
+        from raw prompt text (may contain the ``<event>`` placeholder, in
+        which case ``pixels`` carries its stream). Matching admissions skip
+        the prefix's encode + prefill from then on; non-matching prompts
+        fall back to the full path untouched. Returns the prefix length in
+        cache positions. Safe on a live engine: the prefix prefill builds
+        its own row cache and never touches resident rows."""
+        from eventgpt_tpu.data.tokenizer import tokenize_with_event
+
+        ids = tokenize_with_event(prefix_prompt, self.tokenizer)
+        with self._lock:
+            return self.batcher.set_prefix(ids, pixel_values=pixels)
+
     def status(self, rid: int) -> str:
         """Terminal status of a finished request ('ok' when it finished
         normally or is unknown/still running)."""
@@ -212,6 +226,11 @@ class ServingEngine:
             "faults": self.n_faults,
             "restarts": self.n_restarts,
             "admission_s": round(b.admission_s, 3),
+            # Pipelined-scheduler overlap story (PERFORMANCE.md): how much
+            # host scheduling the in-flight segment is hiding.
+            "pipeline": bool(getattr(b, "pipeline", False)),
+            "overlap_ratio": round(b.overlap_ratio(), 3)
+            if hasattr(b, "overlap_ratio") else 0.0,
             **({"spec_tokens_per_iteration":
                 round(b.spec_tokens_per_iteration(), 2)}
                if b.speculative else {}),
@@ -314,6 +333,13 @@ class ServingEngine:
         tripped = self._consec_faults >= self.breaker_threshold
         with self._lock:
             b = self.batcher
+            # A fault can land mid-pipeline (e.g. at the serve.dispatch
+            # boundary) with a segment still in flight: drop the in-flight
+            # record and the device carry so the restarted scheduler's
+            # first dispatch re-uploads the repaired host view instead of
+            # resuming from stale device state.
+            if hasattr(b, "abort_pipeline"):
+                b.abort_pipeline()
             failed = []
             for r, req in enumerate(b.rows):
                 if req is None:
@@ -464,7 +490,7 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                 self._json(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
-            if self.path not in ("/v1/generate", "/cancel"):
+            if self.path not in ("/v1/generate", "/cancel", "/prefix"):
                 self._json(404, {"error": f"no route {self.path}"})
                 return
             try:
@@ -500,6 +526,27 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                     return
                 self._json(200, {"rid": rid,
                                  "cancelled": engine.cancel(rid)})
+                return
+            if self.path == "/prefix":
+                # Admin route (VERDICT residue): install the shared-prefix
+                # KV seed on a RUNNING server — {"prefix_prompt": str,
+                # optional "event_path"/"event_b64" when the prefix runs
+                # through the event block}. Matching admissions skip the
+                # prefix's encode + prefill from then on.
+                try:
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    prompt = payload["prefix_prompt"]
+                    pixels = None
+                    if "event_path" in payload or "event_b64" in payload:
+                        pixels = _decode_pixels(payload, cfg, event_root)
+                    plen = engine.set_prefix(prompt, pixels)
+                except (KeyError, ValueError) as e:  # bad request
+                    self._json(400, {"error": str(e)})
+                    return
+                except Exception as e:
+                    self._json(500, {"error": str(e)})
+                    return
+                self._json(200, {"prefix_len": plen})
                 return
             from eventgpt_tpu.serve import QueueFullError
 
@@ -685,6 +732,7 @@ def build_server(args) -> tuple:
         draft_head=draft_head,
         first_chunk=getattr(args, "first_chunk", 0),
         max_queue=getattr(args, "max_queue", 0),
+        pipeline=not getattr(args, "no_pipeline", False),
     )
     if args.warmup:
         t0 = time.perf_counter()
@@ -697,6 +745,20 @@ def build_server(args) -> tuple:
         breaker_cooldown_s=getattr(args, "breaker_cooldown_s", 5.0),
         heartbeat_dir=getattr(args, "heartbeat_dir", None),
     )
+    if getattr(args, "prefix_prompt", None):
+        # Startup form of POST /prefix: cache the shared prompt head's KV
+        # once, before traffic. --prefix_event supplies the stream when
+        # the prefix text carries the <event> placeholder.
+        pixels = None
+        if getattr(args, "prefix_event", None):
+            from eventgpt_tpu.ops.image import process_event_file
+
+            _, pixels = process_event_file(
+                args.prefix_event, cfg.num_event_frames,
+                cfg.vision.image_size,
+            )
+        plen = engine.set_prefix(args.prefix_prompt, pixels)
+        print(f"[serve] shared prefix cached: {plen} positions")
     default_deadline = getattr(args, "default_deadline_s", 0) or None
     httpd = ThreadingHTTPServer(
         (args.host, args.port),
@@ -745,6 +807,21 @@ def main(argv=None):
                         "admission owes its first token (0 = off; "
                         "PERFORMANCE.md serving section for the tradeoff)")
     p.add_argument("--warmup", action="store_true")
+    p.add_argument("--no_pipeline", action="store_true",
+                   help="disable pipelined scheduling (dispatch segment "
+                        "N+1 from device-resident state while the host "
+                        "harvests segment N); the synchronous escape "
+                        "hatch — chains are byte-identical either way")
+    p.add_argument("--prefix_prompt", default=None,
+                   help="shared prompt-prefix text cached once at startup "
+                        "(ContinuousBatcher.set_prefix); may contain the "
+                        "<event> placeholder if --prefix_event supplies "
+                        "its stream. Also settable at runtime via "
+                        "POST /prefix")
+    p.add_argument("--prefix_event", default=None,
+                   help="event .npy backing the <event> block inside "
+                        "--prefix_prompt (prefix-through-event-block "
+                        "sessions; suffixes then skip CLIP encode)")
     # -- request-lifecycle hardening (ISSUE 1) --
     p.add_argument("--max_queue", type=int, default=256,
                    help="admission-queue bound: submits beyond this get "

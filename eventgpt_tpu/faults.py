@@ -31,9 +31,12 @@ Examples::
     EGPT_FAULTS="train.step:delay=0.05"       # every micro-step +50 ms
 
 Wired sites (grep ``maybe_fail(`` for the authoritative list):
-``serve.step`` / ``serve.admit`` (``ContinuousBatcher``), ``serve.loop``
-(``ServingEngine`` scheduler thread), ``multiproc.launch`` /
-``multiproc.worker`` (``parallel/multiproc.py`` bootstrap), and
+``serve.step`` / ``serve.admit`` / ``serve.dispatch``
+(``ContinuousBatcher``; the last fires at the pipelined scheduler's
+segment-dispatch boundary — a fault there can land with a segment still
+in flight, the window the engine's abort/restart path must survive),
+``serve.loop`` (``ServingEngine`` scheduler thread), ``multiproc.launch``
+/ ``multiproc.worker`` (``parallel/multiproc.py`` bootstrap), and
 ``train.step`` (``Trainer`` micro-batch boundary).
 
 Injected failures raise ``InjectedFault`` (a ``RuntimeError``): the

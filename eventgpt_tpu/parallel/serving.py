@@ -165,6 +165,17 @@ def replicate(x, mesh: Mesh):
     return jax.device_put(arr, NamedSharding(mesh, P(*([None] * arr.ndim))))
 
 
+def place_carry(mesh: Mesh, batch: int, frozen, n_rem, base_pos=None):
+    """Place the pipelined scheduler's (frozen, n_rem, base_pos) control
+    carry on the serving batch axes — the same placement the segment jits
+    pin for their carry OUTPUTS, so a host-rebuilt carry (after an
+    admission or forced finish) feeds the next dispatch without a
+    reshard. ``base_pos`` may be None (plain decode has no gather base)."""
+    sh = NamedSharding(mesh, P(serving_batch_axes(mesh, batch) or None))
+    put = lambda x: None if x is None else jax.device_put(jnp.asarray(x), sh)
+    return put(frozen), put(n_rem), put(base_pos)
+
+
 def shard_kv_cache(cache: Any, cfg, mesh: Mesh) -> Any:
     """Place a fresh KV cache: (L, B, S, KV, hd) with batch over the serving
     batch axes and KV heads over ``model`` (skipped if it does not divide

@@ -30,6 +30,15 @@ TPU-shaped design (everything jit-visible is static-shape):
     buffer edge; XLA *drops*, not clamps, out-of-bounds scatter updates,
     so the slack is the invariant that matters) — are masked out of every
     attention read, and are overwritten when the row is re-admitted.
+  * PIPELINED scheduling (default): the between-segment control state
+    (frozen mask, per-row budgets, gather base) is ALSO device-resident,
+    updated in-graph by the segment kernels, so segment N+1 dispatches
+    from device state while the host is still harvesting segment N —
+    detokenization, history/draft bookkeeping and admission prep overlap
+    device compute instead of serializing between dispatches. At most
+    one segment is in flight; row mutations (admission, cancel,
+    deadline) drain the pipeline at the dispatch boundary first. Chains
+    are byte-identical to the synchronous path (``pipeline=False``).
 
 Mesh-sharded serving (``mesh=``): the resident cache / logits / ids_buf
 are placed by ``parallel/serving.py``'s layout (batch over ``(data,
@@ -101,13 +110,20 @@ def _decode_segment(
     eos_token_id: int,
     temperature: float = 0.0,
     top_p: float = 1.0,
+    nan_gate: bool = True,
 ):
     """Up to ``chunk`` decode steps over the shared batch.
 
-    Returns (tokens (B, chunk), n_new (B,), done (B,), logits, cache, key):
-    ``tokens[r, :n_new[r]]`` are row r's newly committed tokens;
-    ``done[r]`` marks rows that hit EOS inside this segment (budget
-    exhaustion is the host's bookkeeping via n_rem - n_new == 0).
+    Returns (tokens (B, chunk), n_new (B,), done (B,), finite, logits,
+    cache, key, frozen_out, n_rem_out): ``tokens[r, :n_new[r]]`` are row
+    r's newly committed tokens; ``done[r]`` marks rows that hit EOS inside
+    this segment (budget exhaustion is the host's bookkeeping via
+    n_rem - n_new == 0). ``frozen_out``/``n_rem_out`` are the NEXT
+    segment's control state computed in-graph — the exact bookkeeping the
+    host harvest applies (freeze on EOS / budget exhaustion / non-finite
+    logits when ``nan_gate``), kept device-resident so the pipelined
+    scheduler can dispatch segment N+1 from them before segment N's
+    outputs are ever fetched to the host.
     """
     b = logits.shape[0]
     tokens0 = jnp.full((b, chunk), eos_token_id, jnp.int32)
@@ -153,12 +169,22 @@ def _decode_segment(
     # per segment, no extra host dispatch): the scheduler quarantines a
     # non-finite row instead of letting NaN logits poison the engine.
     finite = jnp.isfinite(logits).all(axis=-1)
-    return tokens, n_new, done, finite, logits, cache, key
+    # Device-resident scheduler carry: mirror the host harvest's row
+    # bookkeeping (budget decrement, freeze on EOS / exhaustion / NaN
+    # quarantine) so the next segment can dispatch without a host sync.
+    n_rem_out = n_rem - n_new
+    frozen_out = frozen | done | (n_rem_out <= 0)
+    if nan_gate:
+        frozen_out = frozen_out | ~finite
+    n_rem_out = jnp.where(frozen_out, 0, n_rem_out)
+    return (tokens, n_new, done, finite, logits, cache, key,
+            frozen_out, n_rem_out)
 
 
 _decode_segment_jit = functools.partial(
     jax.jit,
-    static_argnames=("cfg", "chunk", "eos_token_id", "temperature", "top_p"),
+    static_argnames=("cfg", "chunk", "eos_token_id", "temperature", "top_p",
+                     "nan_gate"),
     donate_argnames=("cache",),
 )(_decode_segment)
 
@@ -199,9 +225,13 @@ def _spec_segment(
     row is ``done`` only when its EOS lands within that cap.
 
     Returns (ids_buf, n_new (B,), done (B,), cache, key, drafts,
-    n_iters_run) — the last is the executed iteration count, so the
-    server can report REALIZED acceptance (committed tokens per verify
-    iteration) on live traffic instead of inferring it.
+    n_iters_run, frozen_out, n_rem_out, base_pos_out) — ``n_iters_run``
+    is the executed iteration count, so the server can report REALIZED
+    acceptance (committed tokens per verify iteration) on live traffic
+    instead of inferring it; the last three are the next segment's
+    device-resident control state (the same bookkeeping the host harvest
+    applies), so the pipelined scheduler can dispatch segment N+1 before
+    fetching segment N.
     """
     from eventgpt_tpu.models.eventchat import _spec_draft_verify
 
@@ -249,7 +279,15 @@ def _spec_segment(
         (jnp.int32(0), ids_buf, jnp.zeros((b,), jnp.int32),
          jnp.zeros((b,), bool), cache, key, drafts),
     )
-    return ids_buf, n_new, done, cache, key, drafts, it
+    # Device-resident scheduler carry (see _decode_segment): the
+    # speculative path's NaN gate is the admission check, so the carry is
+    # just EOS/budget bookkeeping plus the advanced gather base.
+    n_rem_out = n_rem - n_new
+    frozen_out = frozen | done | (n_rem_out <= 0)
+    n_rem_out = jnp.where(frozen_out, 0, n_rem_out)
+    base_pos_out = base_pos + n_new
+    return (ids_buf, n_new, done, cache, key, drafts, it,
+            frozen_out, n_rem_out, base_pos_out)
 
 
 _spec_segment_jit = functools.partial(
@@ -398,18 +436,21 @@ def _gather_new_jit(ids_buf, base_pos, width: int):
 
 @functools.lru_cache(maxsize=16)
 def _get_sharded_decode_segment(
-    cfg, chunk, eos_token_id, temperature, top_p,
+    cfg, chunk, eos_token_id, temperature, top_p, nan_gate,
     flat_cache_sh, cache_treedef, logits_sh, toks_sh, b_sh, key_sh,
 ):
     cache_sh = jax.tree_util.tree_unflatten(cache_treedef, list(flat_cache_sh))
     return jax.jit(
         lambda params, logits, cache, key, frozen, n_rem: _decode_segment(
             params, cfg, logits, cache, key, frozen, n_rem,
-            chunk, eos_token_id, temperature, top_p,
+            chunk, eos_token_id, temperature, top_p, nan_gate,
         ),
         donate_argnums=(2,),
+        # The trailing (b_sh, b_sh) pins the device-resident carry
+        # (frozen_out, n_rem_out) to the batch placement so the pipelined
+        # re-dispatch feeds it straight back without a reshard.
         out_shardings=(toks_sh, b_sh, b_sh, b_sh, logits_sh, cache_sh,
-                       key_sh),
+                       key_sh, b_sh, b_sh),
     )
 
 
@@ -431,8 +472,10 @@ def _get_sharded_spec_segment(
             history=history, medusa=medusa, drafts=drafts,
         ),
         donate_argnums=(1,),
+        # Trailing (b_sh, b_sh, b_sh): the pipelined carry pins
+        # (frozen_out, n_rem_out, base_pos_out) — see the decode variant.
         out_shardings=(ids_sh, b_sh, b_sh, cache_sh, key_sh, drafts_sh,
-                       scalar_sh),
+                       scalar_sh, b_sh, b_sh, b_sh),
     )
 
 
@@ -546,6 +589,7 @@ class ContinuousBatcher:
         first_chunk: int = 0,
         max_queue: int = 0,
         nan_check: bool = True,
+        pipeline: bool = True,
     ):
         if prefill_chunk and (2 * SEQ_BUCKET) % prefill_chunk:
             # A chunk that does not divide the bucket grain would force
@@ -673,6 +717,23 @@ class ContinuousBatcher:
         self.prefill_chunk = int(prefill_chunk)
         self._pending: Optional[_PendingAdmission] = None
         self._prefix = None  # shared-prefix KV seed (set_prefix)
+        # Pipelined scheduling (the default): between-segment control state
+        # (frozen / n_rem / base_pos) ALSO lives on device, updated
+        # in-graph by the segment kernels, so segment N+1 is dispatched
+        # from device state before segment N's outputs are fetched and the
+        # host harvest runs concurrently with device compute. Double-
+        # buffered: at most ONE segment in flight; admissions, cancels and
+        # deadline expiries drain the pipeline first (they mutate rows).
+        # ``pipeline=False`` is the synchronous escape hatch — byte-
+        # identical chains either way (rows are independent in attention
+        # and greedy decode is deterministic per row).
+        self.pipeline = bool(pipeline)
+        self._inflight: Optional[dict] = None  # dispatched, unharvested
+        # (frozen, n_rem, base_pos) device arrays as of the LAST dispatch;
+        # None = stale (host mutated rows) -> rebuilt from the host mirror
+        # at the next dispatch. Host mutations only happen drained, so the
+        # mirror is authoritative whenever this is None.
+        self._dev_carry = None
         # Service metrics: per-request TTFT / completion latency keyed by
         # rid, plus the phase-scoped counters reset_serving_stats() owns
         # (admission stall totals/max — the bound chunked prefill exists
@@ -833,21 +894,31 @@ class ContinuousBatcher:
         # slot stays far from the buffer edge (hygiene; writes above the
         # length are masked/dropped either way).
         self.cache = {**self.cache, "length": self.cache["length"] * 0}
-        # Segment executable: all rows frozen -> no-op dispatch.
-        self._segment(
+        # Segment executable(s): all rows frozen -> no-op dispatch that
+        # still compiles and caches. Dispatched with an explicit carry and
+        # record_carry=False so the resident pipeline carry (and the armed
+        # fault plan's serve.dispatch counters) stay untouched.
+        warm_carry = [
             jnp.asarray(np.ones((self.max_batch,), bool)),
             jnp.zeros((self.max_batch,), jnp.int32),
-        )
-        n += 1
-        if self.first_chunk:
+            (jnp.zeros((self.max_batch,), jnp.int32)
+             if self.speculative else None),
+        ]
+        if self.mesh is not None:
+            warm_carry = list(self._serving.place_carry(
+                self.mesh, self.max_batch, *warm_carry
+            ))
+        chunks = [None] + ([self.first_chunk] if self.first_chunk else [])
+        for ck in chunks:
             # The TTFT-ramp segment is its own executable (chunk is a
             # static arg) — warm it too or the first admission pays it.
-            self._segment(
-                jnp.asarray(np.ones((self.max_batch,), bool)),
-                jnp.zeros((self.max_batch,), jnp.int32),
-                chunk=self.first_chunk,
+            rec = self._dispatch_segment(
+                chunk=ck, carry=tuple(warm_carry), record_carry=False,
+                probe_faults=False,
             )
+            jax.block_until_ready(rec["n_new"])
             n += 1
+        self._dev_carry = None
         if self._prefix is not None:
             # Prefix-admission executable (_prefix_prefill at the smallest
             # suffix bucket — query tails; a longer real suffix compiles
@@ -1101,6 +1172,15 @@ class ContinuousBatcher:
             return True
         for r, req in enumerate(self.rows):
             if req is not None and req.rid == rid:
+                # Cancelling an ACTIVE row mutates frozen/n_rem: settle
+                # the in-flight segment first so the forced finish applies
+                # at the dispatch boundary (the tokens it committed in
+                # that segment are kept — same contract as the
+                # synchronous path).
+                self._drain()
+                if self.rows[r] is not req:
+                    # The drained segment finished the row itself.
+                    return False
                 self._finish_row(r, status=STATUS_CANCELLED)
                 return True
         return False
@@ -1108,6 +1188,9 @@ class ContinuousBatcher:
     def run_until_drained(self) -> Dict[int, List[int]]:
         while self.queue or any(r is not None for r in self.rows):
             self.step()
+        # A trailing all-frozen segment can still be in flight after the
+        # final harvest freed every row; collect it before returning.
+        self._drain()
         out, self.finished = self.finished, {}
         return out
 
@@ -1120,21 +1203,63 @@ class ContinuousBatcher:
 
     def reset_serving_stats(self) -> None:
         """Zero the phase-scoped counters (admission stalls, speculative
-        acceptance) — e.g. after warmup or an unmeasured first request,
-        so a measured window reports only its own traffic."""
+        acceptance, pipeline overlap) — e.g. after warmup or an unmeasured
+        first request, so a measured window reports only its own traffic."""
         self.admission_s = 0.0
         self.admission_max_s = 0.0
         self.spec_iterations = 0
         self.spec_tokens = 0
+        # Pipeline overlap accounting (all host-observable, definitions in
+        # PERFORMANCE.md "Pipelined scheduling"):
+        #   device_segment_s  — host time BLOCKED waiting on the device
+        #                       (the visible, un-hidden device time);
+        #   host_gap_s        — host scheduler time between a fetch
+        #                       returning and the next fetch blocking
+        #                       (harvest bookkeeping, admission prep,
+        #                       dispatch calls);
+        #   overlap_hidden_s  — the part of host_gap_s spent while a
+        #                       dispatched segment was verifiably still
+        #                       running on the device (counted only when
+        #                       the following fetch actually blocked).
+        self.seg_count = 0
+        self.device_segment_s = 0.0
+        self.host_gap_s = 0.0
+        self.overlap_hidden_s = 0.0
+        self._t_prev_fetch_end: Optional[float] = None
+
+    def overlap_ratio(self) -> float:
+        """Fraction of host scheduler work hidden behind device compute
+        (0 on the synchronous path: the fetch starts right after its own
+        dispatch, so nothing is ever in flight during host work)."""
+        return (self.overlap_hidden_s / self.host_gap_s
+                if self.host_gap_s > 0 else 0.0)
 
     # -- scheduler core ---------------------------------------------------
 
     def step(self) -> None:
         """One scheduling iteration: expire deadlines, admit into free
         rows (one prefill chunk when a chunked admission is in flight),
-        run one decode segment, harvest finished rows."""
+        dispatch one decode segment, harvest finished rows.
+
+        Pipelined (the default): the segment is dispatched from the
+        device-resident carry FIRST, then the PREVIOUS segment's outputs
+        are fetched — so detokenization, history/draft bookkeeping and
+        admission prep run while the chip is already computing the next
+        segment. Anything that must mutate rows (an expired deadline, an
+        admission into a freed row, a pending chunked prefill) drains the
+        pipeline at the dispatch boundary before it is applied. With
+        ``pipeline=False`` (or while the TTFT ramp owes a first token)
+        every step harvests its own segment — the synchronous schedule.
+        """
         faults.maybe_fail("serve.step")
         faults.maybe_delay("serve.step")
+        if self._inflight is not None and (
+                self._deadline_expired()
+                or self._pending is not None
+                or (self.queue and any(r is None for r in self.rows))):
+            # A forced finish or admission is about to mutate rows: apply
+            # it against settled state, at the dispatch boundary.
+            self._drain()
         self._expire_deadlines()
         t0 = time.perf_counter()
         self._admit()
@@ -1142,47 +1267,70 @@ class ContinuousBatcher:
         self.admission_s += dt_admit
         self.admission_max_s = max(self.admission_max_s, dt_admit)
         if all(r is None for r in self.rows):
+            self._drain()  # trailing all-frozen segment, if any
             return
         if bool(self.frozen.all()):
             # Only reserved (pending-admission) rows exist — nothing to
-            # decode yet; the pending prefill advanced above.
+            # decode yet; the pending prefill advanced above. (The mirror
+            # only lags toward MORE-frozen, so mirror-all-frozen implies
+            # the device carry is all-frozen too.)
+            self._drain()
             return
         chunk = self.chunk
-        if self.first_chunk and any(
+        ramp = bool(self.first_chunk) and any(
             req is not None and not self.frozen[r] and req.t_first is None
             for r, req in enumerate(self.rows)
-        ):
-            # A fresh admission owes its first token: run the short ramp
-            # segment so TTFT is ~first_chunk iterations, not a full chunk.
-            chunk = self.first_chunk
-        tokens, new_np, n_new, done, finite = self._segment(
-            jnp.asarray(self.frozen), jnp.asarray(self.n_rem.astype(np.int32)),
-            chunk=chunk,
         )
-        if self.speculative:
-            self.spec_tokens += int(n_new.sum())
+        if ramp:
+            # A fresh admission owes its first token: run the short ramp
+            # segment so TTFT is ~first_chunk iterations, not a full chunk
+            # — and harvest it synchronously, which is exactly what a
+            # TTFT-sensitive phase wants.
+            chunk = self.first_chunk
+        prev, self._inflight = self._inflight, None
+        rec = self._dispatch_segment(chunk=chunk)
+        if prev is not None:
+            # Harvest segment N while N+1 runs: THE overlap — this fetch
+            # returns as soon as N's outputs exist, not when N+1 ends.
+            self._harvest_segment(prev)
+        if self.pipeline and not ramp:
+            self._inflight = rec
+        else:
+            self._harvest_segment(rec)
+
+    def _drain(self) -> None:
+        """Harvest the in-flight segment (if any): after this the host
+        mirror of frozen/n_rem/base_pos is settled and rows may be
+        mutated."""
+        if self._inflight is not None:
+            rec, self._inflight = self._inflight, None
+            self._harvest_segment(rec)
+
+    def abort_pipeline(self) -> None:
+        """Discard the in-flight segment record and the device carry (the
+        engine's fault path): the dangling dispatch's outputs are ignored
+        — its rows are being failed anyway — and the next dispatch
+        re-uploads the repaired host view."""
+        self._inflight = None
+        self._dev_carry = None
+
+    def _deadline_expired(self) -> bool:
+        """Cheap host predicate: does any live deadline need a forced
+        finish this step? (Gates the pipeline drain — deadline-less
+        traffic, and traffic whose deadlines have headroom, never
+        serializes on it.)"""
+        if self._n_deadlines <= 0:
+            return False
         now = time.perf_counter()
-        for r, req in enumerate(self.rows):
-            if req is None or self.frozen[r]:
-                continue
-            if finite is not None and not finite[r]:
-                # Non-finite logits poison only this ROW: its segment
-                # tokens (sampled from NaN/inf logits) are discarded, the
-                # row is frozen and the request fails with a structured
-                # status — the batch and the engine keep running.
-                self._finish_row(r, status=STATUS_NAN)
-                continue
-            if self.speculative:
-                new = new_np[r, : n_new[r]]
-                self.base_pos[r] += int(n_new[r])
-            else:
-                new = tokens[r, : n_new[r]]
-            if len(new) and req.t_first is None:
-                req.t_first = now
-            req.tokens.extend(int(t) for t in new)
-            self.n_rem[r] -= int(n_new[r])
-            if done[r] or self.n_rem[r] <= 0:
-                self._finish_row(r)
+
+        def expired(req):
+            return req.deadline is not None and now > req.deadline
+
+        return (any(expired(q) for q in self.queue)
+                or (self._pending is not None
+                    and expired(self._pending.req))
+                or any(req is not None and expired(req)
+                       for req in self.rows))
 
     def _expire_deadlines(self) -> None:
         """Forced finish for every request past its deadline: queued ones
@@ -1210,25 +1358,59 @@ class ContinuousBatcher:
             self._finish_forced(p.req, STATUS_DEADLINE)
         for r, req in enumerate(self.rows):
             if req is not None and not self.frozen[r] and expired(req):
-                self._finish_row(r, status=STATUS_DEADLINE)
+                # A deadline can cross between step()'s drain check and
+                # this scan: settle any in-flight segment before mutating
+                # the row (idempotent when already drained), and re-check
+                # — the harvest may have finished the row itself.
+                self._drain()
+                if self.rows[r] is req and not self.frozen[r]:
+                    self._finish_row(r, status=STATUS_DEADLINE)
 
-    def _segment(self, frozen, n_rem, chunk: Optional[int] = None):
-        """Dispatch one decode/spec segment on the resident state. Returns
-        ``(tokens, new_np, n_new, done, finite)`` as host arrays
-        (``tokens`` for the plain path, ``new_np`` the per-row committed
-        window for the speculative path; ``finite`` is the per-row
-        non-finite-logit quarantine mask on the plain path, ``None`` on
-        the speculative path whose NaN gate is the admission check).
+    def _dispatch_segment(self, chunk: Optional[int] = None, carry=None,
+                          record_carry: bool = True,
+                          probe_faults: bool = True) -> dict:
+        """Dispatch one decode/spec segment on the resident state WITHOUT
+        waiting for it, and advance the device-resident carry. Returns the
+        in-flight record ``_harvest_segment`` consumes — every entry a
+        device array future, so the call returns as soon as XLA enqueues
+        the work.
+
         ``chunk`` defaults to the full segment length; the TTFT ramp
         passes ``first_chunk`` (each distinct value is its own cached
-        executable). Also the warmup entry point: with every row frozen
-        the while_loop exits at entry — a no-op dispatch that still
-        compiles and caches the segment executable."""
+        executable). ``carry`` overrides the (frozen, n_rem, base_pos)
+        inputs and ``record_carry=False`` leaves the resident carry
+        untouched — the warmup path, which dispatches an all-frozen
+        segment purely to compile/cache the executable (the while_loop
+        exits at entry). ``probe_faults=False`` also skips the
+        ``serve.dispatch`` fault site there, so armed chaos plans count
+        only scheduler dispatches."""
         if chunk is None:
             chunk = self.chunk
+        if probe_faults:
+            # The dispatch boundary is its own fault site: a fault HERE
+            # lands with a segment possibly in flight, which is exactly
+            # the window the engine's abort/restart path must survive.
+            faults.maybe_fail("serve.dispatch")
+            faults.maybe_delay("serve.dispatch")
+        if carry is not None:
+            frozen, n_rem, base_pos = carry
+        elif self._dev_carry is not None:
+            frozen, n_rem, base_pos = self._dev_carry
+        else:
+            # Host mutated rows (admission / forced finish / init) — all
+            # of which happen drained, so the mirror is authoritative.
+            frozen = jnp.asarray(self.frozen)
+            n_rem = jnp.asarray(self.n_rem.astype(np.int32))
+            base_pos = (jnp.asarray(self.base_pos.astype(np.int32))
+                        if self.speculative else None)
+            if self.mesh is not None:
+                frozen, n_rem, base_pos = self._serving.place_carry(
+                    self.mesh, self.max_batch, frozen, n_rem, base_pos
+                )
+        rec = {"chunk": chunk, "frozen_in": frozen,
+               "wait_at_dispatch": self.device_segment_s}
         if self.speculative:
             n_iters = max(1, chunk // self.speculative)
-            base_pos = jnp.asarray(self.base_pos.astype(np.int32))
             history = (jnp.asarray(self._history.astype(np.int32))
                        if self._history is not None else None)
             if self.mesh is not None:
@@ -1242,14 +1424,16 @@ class ContinuousBatcher:
                     self._drafts_sh,
                 )
                 (self.ids_buf, n_new, done, self.cache, self.key,
-                 self.spec_drafts, it) = fn(
+                 self.spec_drafts, it, frozen_out, n_rem_out,
+                 base_pos_out) = fn(
                     self.params, self.cache, self.key, self.ids_buf,
                     base_pos, frozen, n_rem, history, self.draft_head,
                     self.spec_drafts,
                 )
             else:
                 (self.ids_buf, n_new, done, self.cache, self.key,
-                 self.spec_drafts, it) = (
+                 self.spec_drafts, it, frozen_out, n_rem_out,
+                 base_pos_out) = (
                     _spec_segment_jit(
                         self.params, self.cfg, self.cache, self.key,
                         self.ids_buf, base_pos,
@@ -1261,56 +1445,136 @@ class ContinuousBatcher:
                 )
             # Read back only the window a segment could have written
             # (n_iters * window <= max(chunk, window) slots per row), not
-            # the whole (B, max_len) buffer — and everything the host
-            # needs in ONE device_get (each transfer is its own round
-            # trip through the tunnel).
+            # the whole (B, max_len) buffer. The gather runs on the
+            # OUTPUT ids_buf at the PRE-segment base — enqueued now, so
+            # the harvest is one device_get with no extra dispatch.
             width = max(chunk, self.speculative)
-            new_np, it_v, n_new, done = jax.device_get(
-                (_gather_new_jit(self.ids_buf, base_pos, width),
-                 it, n_new, done)
+            rec.update(
+                gather=_gather_new_jit(self.ids_buf, base_pos, width),
+                it=it, n_new=n_new, done=done,
             )
-            self.spec_iterations += int(it_v)
-            new_np = np.asarray(new_np)
-            tokens = None
-            finite = None
         else:
             if self.mesh is not None:
                 fn = _get_sharded_decode_segment(
                     self.cfg, chunk, int(self.eos),
-                    self.temperature, self.top_p,
+                    self.temperature, self.top_p, self.nan_check,
                     self._cache_flat_sh, self._cache_treedef,
                     self._logits_sh, self._toks_sh, self._b_sh, self._key_sh,
                 )
                 (tokens, n_new, done, fin, self.logits, self.cache,
-                 self.key) = fn(
+                 self.key, frozen_out, n_rem_out) = fn(
                     self.params, self.logits, self.cache, self.key,
                     frozen, n_rem,
                 )
             else:
                 (tokens, n_new, done, fin, self.logits, self.cache,
-                 self.key) = (
+                 self.key, frozen_out, n_rem_out) = (
                     _decode_segment_jit(
                         self.params, self.cfg, self.logits, self.cache,
                         self.key, frozen, n_rem, chunk, int(self.eos),
-                        self.temperature, self.top_p,
+                        self.temperature, self.top_p, self.nan_check,
                     )
                 )
+            base_pos_out = None
+            rec.update(tokens=tokens, n_new=n_new, done=done, fin=fin)
+        if record_carry:
+            self._dev_carry = (frozen_out, n_rem_out, base_pos_out)
+            self.seg_count += 1
+        rec["t_dispatch"] = time.perf_counter()
+        return rec
+
+    def _harvest_segment(self, rec: dict) -> None:
+        """Fetch one dispatched segment's outputs (the host blocks HERE,
+        and only here) and apply the row bookkeeping: commit tokens,
+        stamp TTFT, decrement budgets, finish EOS/exhausted/NaN rows —
+        the same transitions the segment already applied to the device
+        carry, so no re-upload is needed on this path."""
+        t_fetch = time.perf_counter()
+        if self._t_prev_fetch_end is not None:
+            self.host_gap_s += t_fetch - self._t_prev_fetch_end
+        if self.speculative:
+            new_np, it_v, n_new, done, frozen_in = jax.device_get(
+                (rec["gather"], rec["it"], rec["n_new"], rec["done"],
+                 rec["frozen_in"])
+            )
+            new_np = np.asarray(new_np)
+            tokens = None
+            finite = None
+        else:
             # The quarantine mask is computed in-graph and rides the same
             # device_get as the segment outputs — no extra dispatch or
             # round trip on the hot path.
-            tokens, n_new, done, finite = jax.device_get(
-                (tokens, n_new, done, fin))
+            tokens, n_new, done, finite, frozen_in = jax.device_get(
+                (rec["tokens"], rec["n_new"], rec["done"], rec["fin"],
+                 rec["frozen_in"])
+            )
             finite = np.asarray(finite) if self.nan_check else None
             tokens = np.asarray(tokens)
             new_np = None
-        return (tokens, new_np, np.asarray(n_new), np.asarray(done),
-                finite)
+        t_end = time.perf_counter()
+        wait = t_end - t_fetch
+        if wait > 1e-4:
+            # The device was still busy when the host arrived: everything
+            # the host did since this segment's dispatch — minus any time
+            # it spent blocked fetching the previous segment — ran hidden
+            # behind device compute.
+            blocked_since = self.device_segment_s - rec["wait_at_dispatch"]
+            self.overlap_hidden_s += max(
+                0.0, t_fetch - rec["t_dispatch"] - blocked_since
+            )
+        self.device_segment_s += wait
+        self._t_prev_fetch_end = t_end
+        if self.speculative:
+            self.spec_iterations += int(it_v)
+            self.spec_tokens += int(n_new.sum())
+        n_new = np.asarray(n_new)
+        done = np.asarray(done)
+        frozen_in = np.asarray(frozen_in)
+        now = time.perf_counter()
+        for r, req in enumerate(self.rows):
+            # frozen_in is the segment's INPUT freeze mask (the host
+            # mirror may already be one segment ahead of this harvest):
+            # rows frozen at dispatch produced nothing here.
+            if req is None or frozen_in[r]:
+                continue
+            if finite is not None and not finite[r]:
+                # Non-finite logits poison only this ROW: its segment
+                # tokens (sampled from NaN/inf logits) are discarded, the
+                # row is frozen and the request fails with a structured
+                # status — the batch and the engine keep running. (The
+                # in-graph carry froze it the same way: nan_gate mirrors
+                # nan_check.)
+                self._finish_row(r, status=STATUS_NAN, stale_carry=False)
+                continue
+            if self.speculative:
+                new = new_np[r, : n_new[r]]
+                self.base_pos[r] += int(n_new[r])
+            else:
+                new = tokens[r, : n_new[r]]
+            if len(new) and req.t_first is None:
+                req.t_first = now
+            req.tokens.extend(int(t) for t in new)
+            self.n_rem[r] -= int(n_new[r])
+            if done[r] or self.n_rem[r] <= 0:
+                # The device carry already froze this row in-graph — the
+                # harvest only mirrors it, so the carry stays valid.
+                self._finish_row(r, stale_carry=False)
 
-    def _finish_row(self, r: int, status: str = STATUS_OK) -> None:
+    def _finish_row(self, r: int, status: str = STATUS_OK,
+                    stale_carry: bool = True) -> None:
         req = self.rows[r]
         self.rows[r] = None
         self.frozen[r] = True
         self.n_rem[r] = 0
+        if stale_carry:
+            # External forced finish (deadline / cancel): the device carry
+            # no longer matches the host view — rebuild it from the
+            # mirror at the next dispatch. Callers guarantee the pipeline
+            # is drained first, so the mirror is settled. Harvest-driven
+            # finishes pass False: the segment froze the row in-graph
+            # already, and invalidating here would roll the carry back
+            # behind a segment that is already in flight.
+            self._dev_carry = None
         self._record_finish(req, status)
 
     def _finish_forced(self, req: _Request, status: str) -> None:
@@ -1531,6 +1795,10 @@ class ContinuousBatcher:
         )
         self.rows[row] = req
         req.row = row
+        # Row activation below rewrites frozen/n_rem (and base_pos for
+        # speculative rows): the next dispatch re-uploads the host mirror.
+        # _admit only runs drained, so the mirror is settled here.
+        self._dev_carry = None
         if self.draft_head is not None and self.speculative > 1:
             from eventgpt_tpu.models import medusa as medusa_mod
 

@@ -39,19 +39,53 @@ Params = Dict[str, Any]
 Batch = Dict[str, jnp.ndarray]
 
 
-def multimodal_embeds(params: Params, cfg: EventChatConfig, batch: Batch) -> jnp.ndarray:
+def multimodal_embeds(params: Params, cfg: EventChatConfig, batch: Batch,
+                      mesh=None) -> jnp.ndarray:
     """Fixed-layout splice: text embeddings with event tokens gathered in.
 
     ``event_index[b, t]`` maps each event slot to its row in the pooled
     event-token block; non-event positions read the text embedding table.
+
+    ``mesh`` pins the CLIP/event activations and text embeddings to the
+    batch sharding (VERDICT r5 weak #1): without the pin, GSPMD resolves
+    the conflict between the batch-sharded pixels and the fsdp/model-
+    sharded CLIP+projector weights by rematerializing the activations
+    per layer ("involuntary full rematerialization" on every sharded
+    train step).
     """
-    ev = eventchat.encode_events_batch(params, cfg, batch["pixel_values"])  # (B,E,D)
-    txt = llama_mod.embed_tokens(params["llama"], batch["token_ids"])       # (B,T,D)
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        from eventgpt_tpu.parallel.sharding import batch_spec
+
+        pin = lambda x: jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, batch_spec(x.ndim))
+        )
+    else:
+        pin = lambda x: x
+    ev = eventchat.encode_events_batch(
+        params, cfg, pin(batch["pixel_values"]), mesh=mesh
+    )  # (B,E,D)
+    ev = pin(ev)
+    llama_params = params["llama"]
+    if mesh is not None and not isinstance(llama_params["embed_tokens"], dict):
+        # Pin the table's feature dim replicated for THIS gather: the
+        # partitioner already all-gathers the (model, fsdp)-sharded table
+        # to serve batch-sharded indices, but without the pin it lays the
+        # gather output out D-sharded and then force-remats it to the
+        # batch sharding the splice needs.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        llama_params = {**llama_params, "embed_tokens":
+                        jax.lax.with_sharding_constraint(
+                            llama_params["embed_tokens"],
+                            NamedSharding(mesh, P("model", None)))}
+    txt = pin(llama_mod.embed_tokens(llama_params, batch["token_ids"]))  # (B,T,D)
     ev = ev.astype(txt.dtype)
     gathered = jnp.take_along_axis(
         ev, batch["event_index"][:, :, None].astype(jnp.int32), axis=1
     )  # (B,T,D)
-    return jnp.where(batch["event_pos"][:, :, None], gathered, txt)
+    return pin(jnp.where(batch["event_pos"][:, :, None], gathered, txt))
 
 
 def lm_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -69,7 +103,7 @@ def lm_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.
 
 def _forward_loss(params: Params, cfg: EventChatConfig, batch: Batch,
                   mesh=None) -> jnp.ndarray:
-    embeds = multimodal_embeds(params, cfg, batch)
+    embeds = multimodal_embeds(params, cfg, batch, mesh=mesh)
     logits = llama_mod.forward(params["llama"], cfg.llama, embeds,
                                batch["attn_mask"], mesh=mesh)
     loss, _ = lm_loss(logits, batch["labels"])
@@ -187,7 +221,7 @@ def make_eval_step(cfg: EventChatConfig,
     @jax.jit
     def step(state: TrainState, batch: Batch):
         params = combine(state.trainable, state.frozen)
-        embeds = multimodal_embeds(params, cfg, batch)
+        embeds = multimodal_embeds(params, cfg, batch, mesh=mesh)
         logits = llama_mod.forward(params["llama"], cfg.llama, embeds,
                                    batch["attn_mask"], mesh=mesh)
         loss, n = lm_loss(logits, batch["labels"])

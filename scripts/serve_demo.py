@@ -56,6 +56,10 @@ def main(argv=None):
     p.add_argument("--prefill_chunk", type=int, default=0,
                    help="decode-interleaved admission prefill chunk "
                         "(0 = one-shot admission prefill)")
+    p.add_argument("--no_pipeline", action="store_true",
+                   help="disable the pipelined scheduler (synchronous "
+                        "segment dispatch; chains are identical either "
+                        "way)")
     p.add_argument("--first_chunk", type=int, default=0,
                    help="TTFT ramp: short segment while a fresh admission "
                         "owes its first token (0 = off)")
@@ -112,6 +116,7 @@ def main(argv=None):
         kv_quant=args.kv_cache == "int8", speculative=args.speculative,
         mesh=mesh, prefill_chunk=args.prefill_chunk,
         draft_head=draft_head, first_chunk=args.first_chunk,
+        pipeline=not args.no_pipeline,
     )
     if args.warmup:
         t0 = time.perf_counter()

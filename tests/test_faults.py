@@ -175,6 +175,7 @@ def test_deadline_expires_mid_decode_and_frees_the_row(tiny):
     srv = _batcher(tiny, max_batch=1)
     rid = srv.submit([1, -200, 5], _pv(cfg), 64, deadline_s=30.0)
     srv.step()                               # admitted + one 2-token segment
+    srv._drain()   # settle the pipelined segment so tokens are visible
     req = next(r for r in srv.rows if r is not None)
     assert req.rid == rid and len(req.tokens) == 2
     req.deadline = time.perf_counter() - 1.0  # deterministic expiry

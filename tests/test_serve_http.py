@@ -427,3 +427,87 @@ def test_warmup_after_admission_raises(server):
     srv.submit([1, -200, 5], pv, 4)
     with pytest.raises(RuntimeError, match="before any request"):
         srv.warmup(prompt_lens=[14])
+
+
+def _tiny_event_b64(tmp_path, n=4000):
+    """Synthetic structured-array event upload for the self-contained
+    servers below — these tests must not depend on the reference samples
+    (the module fixture's servers do)."""
+    import numpy as np
+
+    from eventgpt_tpu.ops.raster import STREAM_DTYPE
+
+    rng = np.random.default_rng(0)
+    arr = np.zeros(n, dtype=STREAM_DTYPE)
+    arr["x"] = rng.integers(0, 64, n)
+    arr["y"] = rng.integers(0, 48, n)
+    arr["t"] = np.sort(rng.integers(0, 50_000, n)).astype(np.uint64)
+    arr["p"] = rng.integers(0, 2, n)
+    path = os.path.join(str(tmp_path), "events.npy")
+    np.save(path, arr)
+    with open(path, "rb") as f:
+        return base64.b64encode(f.read()).decode()
+
+
+def test_prefix_route_reuses_kv_and_keeps_chains(tmp_path):
+    """VERDICT residue: shared-prefix KV reuse through the PRODUCT HTTP
+    server. POST /prefix installs the conversation head's KV once; the
+    same query then takes the suffix-only admission path and must return
+    the byte-identical greedy answer it produced before the prefix
+    existed. Bad payloads are client errors."""
+    import jax
+
+    from eventgpt_tpu.cli.serve import ServingEngine, make_handler
+    from eventgpt_tpu.config import EventChatConfig
+    from eventgpt_tpu.constants import DEFAULT_EV_START_TOKEN
+    from eventgpt_tpu.data.conversation import prepare_event_prompt
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.models import eventchat
+    from eventgpt_tpu.serve import ContinuousBatcher
+    from http.server import ThreadingHTTPServer
+
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None)
+    engine = ServingEngine(srv, load_tokenizer("byte"))
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0), make_handler(engine, cfg))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        b64 = _tiny_event_b64(tmp_path)
+        payload = {"query": "What is happening?", "event_b64": b64,
+                   "max_new_tokens": 6}
+        before = _post(url, payload)
+        assert before["tokens"] == 6
+
+        # The shared head of every request prompt: conversation system
+        # text through "USER: " (everything before the event block).
+        head = prepare_event_prompt(
+            "What is happening?", "eventgpt_v1"
+        ).split(DEFAULT_EV_START_TOKEN)[0]
+        req = urllib.request.Request(
+            url + "/prefix",
+            json.dumps({"prefix_prompt": head}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())
+        assert out["prefix_len"] > 0
+
+        after = _post(url, payload)
+        assert after["answer"] == before["answer"]  # exactness through reuse
+        other = _post(url, {"query": "Anything moving?", "event_b64": b64,
+                            "max_new_tokens": 6})
+        assert other["tokens"] == 6  # a second matching prompt also serves
+
+        bad = urllib.request.Request(
+            url + "/prefix", b'{"nope": 1}',
+            {"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(bad, timeout=60)
+        assert e.value.code == 400
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        engine.shutdown()

@@ -198,7 +198,7 @@ def test_13b_sharded_server_segment_compiles():
     key_sh = NamedSharding(mesh, P())
 
     fn = _get_sharded_decode_segment(
-        cfg, 32, 2, 0.0, 1.0, tuple(flat), treedef,
+        cfg, 32, 2, 0.0, 1.0, True, tuple(flat), treedef,
         logits_sh, toks_sh, b_sh, key_sh,
     )
     logits_abs = jax.ShapeDtypeStruct(
