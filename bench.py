@@ -391,8 +391,15 @@ def run_serve(args):
     import jax.numpy as jnp
     import numpy as np
 
+    from eventgpt_tpu.obs import metrics as obs_metrics
     from eventgpt_tpu.serve import ContinuousBatcher
 
+    # Telemetry A/B (--serve_telemetry 0 disarms the registry): the armed
+    # run records the TTFT / inter-token-latency DISTRIBUTIONS into the
+    # BENCH json, and the pair measures the instrumentation overhead
+    # (<2% contract, PERFORMANCE.md "Telemetry overhead").
+    telemetry = bool(args.serve_telemetry)
+    obs_metrics.configure(telemetry)
     preset, cfg, platform = _resolve_preset(args)
     dtype = jnp.bfloat16
     quant = args.quant if preset in ("7b", "13b") else "bf16"
@@ -431,6 +438,7 @@ def run_serve(args):
     assert len(first[r0]) == args.decode_tokens
 
     srv.reset_serving_stats()  # exclude the warmup/first-request phase
+    obs_metrics.REGISTRY.reset()  # same phase scoping for the registry
     t0 = time.perf_counter()
     rids = [srv.submit(ids, pixels, args.decode_tokens)
             for _ in range(n_req)]
@@ -480,7 +488,18 @@ def run_serve(args):
            if args.serve_spec else {}),
         "quant": quant,
         "platform": platform,
+        "telemetry": telemetry,
     }
+    if telemetry:
+        # Registry snapshot: the latency DISTRIBUTIONS (log2-bucket
+        # summaries), not just the means/percentiles numpy computed above
+        # — so the perf trajectory carries shape, and the numbers are the
+        # exact ones a live server would expose on /metrics.
+        record["metrics"] = obs_metrics.REGISTRY.summary((
+            "egpt_serve_ttft_seconds", "egpt_serve_itl_seconds",
+            "egpt_serve_queue_wait_seconds", "egpt_serve_segment_seconds",
+            "egpt_serve_batch_occupancy_rows",
+        ))
     print(json.dumps(record))
     return record
 
@@ -1042,6 +1061,10 @@ def main() -> None:
                    help="mode=serve: 1 = set a shared system+event prefix "
                         "(set_prefix) so admissions prefill only the query "
                         "tail")
+    p.add_argument("--serve_telemetry", type=int, default=1,
+                   help="mode=serve: 1 (default) = metrics registry armed "
+                        "(TTFT/ITL distributions recorded in the BENCH "
+                        "json); 0 = disarmed, for overhead A/B runs")
     p.add_argument("--serve_pipeline", type=int, default=1,
                    help="mode=serve: 1 (default) = pipelined scheduler "
                         "(segment N+1 dispatched from device-resident "

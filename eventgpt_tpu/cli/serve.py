@@ -22,7 +22,16 @@ Endpoints:
       or (stream) chunked text deltas as they commit, newline-framed JSON.
   GET  /health       -> {"status": "ok", "active": N, "queued": N}
       (lock-free snapshot: answers inside a probe timeout even mid-segment)
-  GET  /stats        -> serverwide counters + recent request stats.
+  GET  /stats        -> serverwide counters + recent request stats +
+      a summary of the telemetry registry (obs/metrics.py).
+  GET  /metrics      -> Prometheus text exposition (scrape target:
+      TTFT / inter-token-latency / queue-wait histograms, counters,
+      breaker state — the catalogue is in OBSERVABILITY.md).
+  GET  /trace        -> Chrome trace JSON of the live span ring
+      (request lifecycles + scheduler dispatch/harvest; load in
+      Perfetto or chrome://tracing).
+  POST /profile      {"seconds": N} -> capture a jax.profiler window of
+      live traffic into --profile_dir; returns the trace directory.
 
 ``event_path`` is directory-allowlisted: without ``--event_root`` it is
 disabled entirely (clients upload streams inline via ``event_b64``), and
@@ -48,6 +57,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from eventgpt_tpu import faults  # stdlib-only; safe before jax loads
+from eventgpt_tpu.obs import metrics as obs_metrics  # stdlib-only too
+from eventgpt_tpu.obs import trace as obs_trace
 
 
 class ServingEngine:
@@ -77,8 +88,12 @@ class ServingEngine:
                  breaker_threshold: int = 3,
                  breaker_cooldown_s: float = 5.0,
                  heartbeat_dir: Optional[str] = None,
-                 heartbeat_interval_s: float = 1.0):
+                 heartbeat_interval_s: float = 1.0,
+                 trace_out: Optional[str] = None):
         self.batcher = batcher
+        # Chrome-trace dump destination written at shutdown (--trace_out);
+        # GET /trace snapshots the live ring any time before that.
+        self.trace_out = trace_out
         self.tokenizer = tokenizer
         self.conv_mode = conv_mode
         self._lock = threading.Lock()
@@ -250,12 +265,21 @@ class ServingEngine:
             "requests": self.n_requests,
             "status": "degraded" if self.breaker_open() else "ok",
             **self._snapshot,
+            # Registry merge (ISSUE 3): the same numbers /metrics exposes
+            # in Prometheus text, summarized — histogram p50/p99 are log2-
+            # bucket upper bounds, see obs/metrics.py.
+            "metrics": obs_metrics.serve_summary(),
         }
 
     def shutdown(self) -> None:
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=10)
+        if self.trace_out:
+            tracer = obs_trace.active()
+            if tracer is not None:
+                n = tracer.write(self.trace_out)
+                print(f"[serve] wrote {n} trace events to {self.trace_out}")
 
     # -- scheduler thread -------------------------------------------------
 
@@ -276,6 +300,7 @@ class ServingEngine:
                             # streak is over and /health returns to ok.
                             self._consec_faults = 0
                             self.fault = None
+                            obs_metrics.SERVE_BREAKER_OPEN.set(0)
                         # Snapshot only when state moved (idle polls would
                         # rebuild 10x/s for nothing); submits wake the
                         # loop, so queue growth shows within one pass.
@@ -288,6 +313,7 @@ class ServingEngine:
                     # brief backoff so a hard fault loop cannot spin.
                     time.sleep(min(0.05 * self._consec_faults, 0.5))
                     self.n_restarts += 1
+                    obs_metrics.SERVE_SCHED_RESTARTS.inc()
                     self._thread = threading.Thread(
                         target=self._loop, daemon=True)
                     self._thread.start()
@@ -331,6 +357,11 @@ class ServingEngine:
         self._consec_faults += 1
         self._t_fault = time.monotonic()
         tripped = self._consec_faults >= self.breaker_threshold
+        obs_metrics.SERVE_SCHED_FAULTS.inc()
+        obs_trace.instant("scheduler_fault", cat="engine", error=repr(e))
+        if tripped:
+            obs_metrics.SERVE_BREAKER_OPEN.set(1)
+            obs_trace.instant("breaker_trip", cat="engine")
         with self._lock:
             b = self.batcher
             # A fault can land mid-pipeline (e.g. at the serve.dispatch
@@ -486,11 +517,33 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                                  "restarts": engine.n_restarts})
             elif self.path == "/stats":
                 self._json(200, engine.stats())
+            elif self.path == "/metrics":
+                # Prometheus text exposition (scrape target). Rendering
+                # walks the registry outside the engine lock — safe inside
+                # a probe timeout even mid-segment, like /health.
+                body = obs_metrics.REGISTRY.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/trace":
+                tracer = obs_trace.active()
+                if tracer is None:
+                    self._json(404, {"error": "tracing disarmed "
+                                              "(--trace_buffer 0)"})
+                    return
+                # Standard Chrome trace JSON object: load directly in
+                # Perfetto / chrome://tracing.
+                self._json(200, {"traceEvents": tracer.events(),
+                                 "droppedEvents": tracer.dropped()})
             else:
                 self._json(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
-            if self.path not in ("/v1/generate", "/cancel", "/prefix"):
+            if self.path not in ("/v1/generate", "/cancel", "/prefix",
+                                 "/profile"):
                 self._json(404, {"error": f"no route {self.path}"})
                 return
             try:
@@ -516,6 +569,34 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                                  f"body {n} bytes exceeds the "
                                  f"{max_body_bytes}-byte limit "
                                  f"(--max_body_mb)"})
+                return
+            if self.path == "/profile":
+                # On-demand jax.profiler window on the RUNNING server:
+                # {"seconds": N} captures N seconds of live traffic into
+                # --profile_dir (or a fresh temp dir) and returns the
+                # trace directory for TensorBoard/XProf. Blocks this
+                # handler thread for the window; the scheduler keeps
+                # serving — that is the traffic being profiled.
+                from eventgpt_tpu.obs import profiling as obs_profiling
+
+                try:
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    seconds = float(payload.get("seconds", 2.0))
+                    if not (0.0 <= seconds <= 120.0):
+                        raise ValueError(
+                            f"seconds must be in [0, 120], got {seconds}")
+                except Exception as e:  # bad request
+                    self._json(400, {"error": str(e)})
+                    return
+                try:
+                    d = obs_profiling.capture(seconds)
+                except obs_profiling.CaptureBusyError as e:
+                    self._json(409, {"error": str(e)})
+                    return
+                except Exception as e:
+                    self._json(500, {"error": str(e)})
+                    return
+                self._json(200, {"profile_dir": d, "seconds": seconds})
                 return
             if self.path == "/cancel":
                 try:
@@ -706,6 +787,22 @@ def build_server(args) -> tuple:
     from eventgpt_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
+    # Telemetry arming (ISSUE 3): metrics are on unless --no_telemetry;
+    # the span tracer keeps a bounded ring (0 disarms); --profile_dir
+    # arms the jax.profiler annotations and sets the POST /profile
+    # destination. All three are chain-neutral — they read clocks, never
+    # jax values (tests/test_obs.py::test_chain_neutrality).
+    if getattr(args, "no_telemetry", False):
+        obs_metrics.configure(False)
+        obs_trace.disable()
+    else:
+        buf = int(getattr(args, "trace_buffer", 65536) or 0)
+        if buf > 0:
+            obs_trace.configure(buf)
+    if getattr(args, "profile_dir", None):
+        from eventgpt_tpu.obs import profiling as obs_profiling
+
+        obs_profiling.configure(args.profile_dir)
     cfg, params, tokenizer = load_model(
         args.model_path, args.dtype, None, args.tokenizer_path
     )
@@ -744,6 +841,7 @@ def build_server(args) -> tuple:
         breaker_threshold=getattr(args, "breaker_threshold", 3),
         breaker_cooldown_s=getattr(args, "breaker_cooldown_s", 5.0),
         heartbeat_dir=getattr(args, "heartbeat_dir", None),
+        trace_out=getattr(args, "trace_out", None),
     )
     if getattr(args, "prefix_prompt", None):
         # Startup form of POST /prefix: cache the shared prompt head's KV
@@ -839,6 +937,22 @@ def main(argv=None):
     p.add_argument("--heartbeat_dir", default=None,
                    help="directory for the serving heartbeat.json "
                         "(train/resilience.py format; unset = disabled)")
+    # -- telemetry (ISSUE 3; OBSERVABILITY.md) --
+    p.add_argument("--trace_buffer", type=int, default=65536,
+                   help="request/step trace ring capacity in events "
+                        "(GET /trace snapshots it; 0 disarms tracing)")
+    p.add_argument("--trace_out", default=None,
+                   help="write the trace ring as Chrome trace events "
+                        "(Perfetto / chrome://tracing) at shutdown")
+    p.add_argument("--profile_dir", default=None,
+                   help="destination for POST /profile jax.profiler "
+                        "captures; setting it also arms the per-segment "
+                        "profiler annotations (unset: captures go to a "
+                        "temp dir)")
+    p.add_argument("--no_telemetry", action="store_true",
+                   help="disarm the metrics registry and the span tracer "
+                        "(A/B switch; chains are byte-identical either "
+                        "way — the registry just stops counting)")
     p.add_argument("--faults", default=None,
                    help="arm deterministic fault injection, e.g. "
                         "'serve.step:n=5' (see eventgpt_tpu/faults.py; "

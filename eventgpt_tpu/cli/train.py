@@ -59,6 +59,12 @@ def main(argv=None):
              "ckpt_step*/ckpt_last under --output_dir (crash/preemption "
              "recovery: relaunch the same command with this flag)",
     )
+    parser.add_argument(
+        "--trace_out", type=str, default=None,
+        help="arm the obs span tracer and write the run's Chrome trace "
+             "events here at exit (Perfetto / chrome://tracing; "
+             "OBSERVABILITY.md)",
+    )
     args = parser.parse_args(argv)
 
     initialize_distributed()
@@ -136,7 +142,18 @@ def main(argv=None):
             trainer.resume(latest)
     elif args.resume_from:
         trainer.resume(args.resume_from)
-    metrics = trainer.train()
+    tracer = None
+    if args.trace_out:
+        from eventgpt_tpu.obs import trace as obs_trace
+
+        tracer = obs_trace.configure(65536)
+    try:
+        metrics = trainer.train()
+    finally:
+        if tracer is not None:
+            n = tracer.write(args.trace_out)
+            logging.getLogger(__name__).info(
+                "wrote %d trace events to %s", n, args.trace_out)
     print(metrics)
     return metrics
 

@@ -54,6 +54,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from eventgpt_tpu.obs import metrics as obs_metrics  # stdlib-only, like us
+
 
 class InjectedFault(RuntimeError):
     """A deterministic test-injected failure (never raised in production
@@ -187,6 +189,9 @@ def maybe_fail(site: str) -> None:
         return
     s = _registry.check(site, want_delay=False)
     if s is not None:
+        # Fault trips reach the telemetry registry so a chaos drill shows
+        # on /metrics next to the breaker/restart counters it provokes.
+        obs_metrics.FAULT_TRIPS.inc(site=site, kind="fail")
         raise InjectedFault(
             f"injected fault at {site} (call #{s.calls}, fire #{s.fires})")
 
@@ -199,6 +204,7 @@ def maybe_delay(site: str) -> float:
     s = _registry.check(site, want_delay=True)
     if s is None:
         return 0.0
+    obs_metrics.FAULT_TRIPS.inc(site=site, kind="delay")
     time.sleep(s.delay_s)
     return s.delay_s
 

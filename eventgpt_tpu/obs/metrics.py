@@ -1,0 +1,460 @@
+"""Process-global metrics registry with Prometheus text exposition.
+
+The serving/training stack had only ad-hoc counters (a ``/stats`` dict,
+three scheduler gauges, the trainer heartbeat); this registry is the one
+place a number must be registered to become operable: scrapeable at
+``GET /metrics`` (Prometheus text format 0.0.4), summarized into
+``/stats``, and dumped per train step into ``telemetry.jsonl``.
+
+Rules (enforced statically by ``scripts/lint_telemetry.py``):
+
+  * every metric name matches ``egpt_[a-z0-9_]+`` and is registered
+    EXACTLY ONCE, at import time, in THIS module — call sites import the
+    metric object (``SERVE_TTFT.observe(dt)``), they never register;
+  * hot paths time with ``time.perf_counter`` (monotonic), never
+    ``time.time``.
+
+Thread-safety: every mutation takes the metric's lock (scheduler,
+handler and trainer threads all observe). Cost: a histogram observe is
+one bisect + three dict writes under a lock — sub-microsecond, a few
+dozen per decode segment, measured <2% of serve throughput end to end
+(PERFORMANCE.md "Telemetry overhead").
+
+Histograms are FIXED-BUCKET log2: upper bounds at powers of two, so
+bucket assignment is a bisect over ~30 floats, merging across processes
+is trivial (same bounds always), and the exposition stays small. The
+price is factor-of-2 quantile resolution — the right trade for latency
+telemetry (you care about 2x regressions, not 5%).
+
+Disarm with ``configure(enabled=False)`` (one module-global bool read
+per call when off). Telemetry never touches jax values either way —
+chains are byte-identical on/off (tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+from bisect import bisect_left
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+NAME_RE = re.compile(r"^egpt_[a-z0-9_]+$")
+
+_INF = float("inf")
+
+
+def log2_buckets(lo: float, hi: float) -> Tuple[float, ...]:
+    """Power-of-two upper bounds covering [lo, hi]: the first bound is
+    the largest 2^k <= lo, the last the smallest 2^k >= hi. (+Inf is
+    implicit — every histogram has an overflow bucket.)"""
+    if not (lo > 0 and hi > lo):
+        raise ValueError(f"need 0 < lo < hi, got ({lo}, {hi})")
+    e = math.floor(math.log2(lo) + 1e-12)
+    out = []
+    while True:
+        b = 2.0 ** e
+        out.append(b)
+        if b >= hi:
+            return tuple(out)
+        e += 1
+
+
+# Shared bucket families (the catalogue in OBSERVABILITY.md):
+#   LATENCY — 61 us .. 128 s: request-scale times (TTFT, queue wait,
+#             completion, admission, train step).
+#   SHORT   — 0.95 us .. 8 s: per-token / per-segment times (ITL,
+#             segment wait, data wait).
+#   ROWS    — 1 .. 1024: batch-occupancy style small counts.
+LATENCY_BUCKETS = log2_buckets(2.0 ** -14, 2.0 ** 7)
+SHORT_BUCKETS = log2_buckets(2.0 ** -20, 2.0 ** 3)
+ROWS_BUCKETS = tuple(float(2 ** e) for e in range(0, 11))
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value / le formatting: integral floats render
+    without the trailing .0 (golden-test stable across Python versions)."""
+    if v == _INF:
+        return "+Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """Base: one name, one help string, samples keyed by sorted label
+    tuples. Subclasses hold the per-key state under ``self._lock``."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, registry: "Registry"):
+        self.name = name
+        self.help = help
+        self._reg = registry
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+        if not labels:
+            return ()
+        return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help, registry):
+        super().__init__(name, help, registry)
+        self._values: Dict[tuple, float] = {}
+
+    def inc(self, n: float = 1.0, **labels) -> None:
+        if not self._reg.enabled:
+            return
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + n
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._values.values())
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def _render(self, common: tuple) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items:
+            items = [((), 0.0)]
+        return [f"{self.name}{_label_str(common + k)} {_fmt(v)}"
+                for k, v in items]
+
+    def _summary(self):
+        with self._lock:
+            if not self._values:
+                return 0.0
+            if list(self._values) == [()]:
+                return self._values[()]
+            return {_label_str(k) or "_": v
+                    for k, v in sorted(self._values.items())}
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, v: float, **labels) -> None:
+        if not self._reg.enabled:
+            return
+        with self._lock:
+            self._values[self._key(labels)] = float(v)
+
+
+class Histogram(_Metric):
+    """Fixed-bucket log2 histogram. ``observe(v, n=k)`` adds ``k``
+    observations of value ``v`` (one lock round-trip for a whole decode
+    segment's worth of per-token gaps)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help, registry,
+                 buckets: Sequence[float] = LATENCY_BUCKETS):
+        super().__init__(name, help, registry)
+        bounds = tuple(float(b) for b in buckets)
+        if list(bounds) != sorted(set(bounds)) or (bounds and bounds[-1] == _INF):
+            raise ValueError(f"buckets must be strictly increasing and "
+                             f"finite (+Inf is implicit): {bounds}")
+        self.bounds = bounds
+        # per label-key: [counts per bound + overflow], sum, count
+        self._counts: Dict[tuple, List[float]] = {}
+        self._sums: Dict[tuple, float] = {}
+        self._totals: Dict[tuple, float] = {}
+
+    def observe(self, v: float, n: int = 1, **labels) -> None:
+        if not self._reg.enabled or n <= 0:
+            return
+        i = bisect_left(self.bounds, v)  # bucket upper bounds: le semantics
+        k = self._key(labels)
+        with self._lock:
+            c = self._counts.get(k)
+            if c is None:
+                c = self._counts[k] = [0.0] * (len(self.bounds) + 1)
+                self._sums[k] = 0.0
+                self._totals[k] = 0.0
+            c[i] += n
+            self._sums[k] += v * n
+            self._totals[k] += n
+
+    def count(self, **labels) -> float:
+        with self._lock:
+            return self._totals.get(self._key(labels), 0.0)
+
+    def quantile(self, q: float) -> float:
+        """Upper-bound estimate of the q-quantile, aggregated over every
+        label set: the smallest bucket bound whose cumulative count
+        reaches q * total (log2 buckets -> factor-2 resolution). 0.0
+        when empty; the last finite bound stands in for +Inf overflow."""
+        with self._lock:
+            total = sum(self._totals.values())
+            if total <= 0:
+                return 0.0
+            agg = [0.0] * (len(self.bounds) + 1)
+            for c in self._counts.values():
+                for i, v in enumerate(c):
+                    agg[i] += v
+        need = q * total
+        cum = 0.0
+        for i, v in enumerate(agg):
+            cum += v
+            if cum >= need - 1e-9:
+                return self.bounds[i] if i < len(self.bounds) else self.bounds[-1]
+        return self.bounds[-1]
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+            self._totals.clear()
+
+    def _render(self, common: tuple) -> List[str]:
+        with self._lock:
+            keys = sorted(self._counts)
+            rows = [(k, list(self._counts[k]), self._sums[k], self._totals[k])
+                    for k in keys]
+        if not rows:
+            rows = [((), [0.0] * (len(self.bounds) + 1), 0.0, 0.0)]
+        out = []
+        for k, counts, s, total in rows:
+            cum = 0.0
+            for bound, c in zip(self.bounds + (_INF,), counts):
+                cum += c
+                lk = common + k + (("le", _fmt(bound)),)
+                out.append(f"{self.name}_bucket{_label_str(lk)} {_fmt(cum)}")
+            out.append(f"{self.name}_sum{_label_str(common + k)} {_fmt(s)}")
+            out.append(f"{self.name}_count{_label_str(common + k)} {_fmt(total)}")
+        return out
+
+    def _summary(self):
+        with self._lock:
+            total = sum(self._totals.values())
+            s = sum(self._sums.values())
+        if total <= 0:
+            return {"count": 0}
+        return {
+            "count": int(total),
+            "sum": round(s, 6),
+            "mean": round(s / total, 6),
+            "p50": self.quantile(0.50),
+            "p99": self.quantile(0.99),
+        }
+
+
+class Registry:
+    """Name -> metric, rendered in registration order. One process-global
+    instance (``REGISTRY``) below; tests build private ones."""
+
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+        self._common: Tuple[Tuple[str, str], ...] = ()
+        self.enabled = True
+
+    def _register(self, m: _Metric) -> _Metric:
+        with self._lock:
+            if m.name in self._metrics:
+                raise ValueError(
+                    f"metric {m.name!r} is already registered — metrics are "
+                    f"defined exactly once, at import, in obs/metrics.py")
+            if not NAME_RE.match(m.name):
+                raise ValueError(
+                    f"metric name {m.name!r} must match {NAME_RE.pattern}")
+            self._metrics[m.name] = m
+        return m
+
+    def counter(self, name: str, help: str) -> Counter:
+        return self._register(Counter(name, help, self))
+
+    def gauge(self, name: str, help: str) -> Gauge:
+        return self._register(Gauge(name, help, self))
+
+    def histogram(self, name: str, help: str,
+                  buckets: Sequence[float] = LATENCY_BUCKETS) -> Histogram:
+        return self._register(Histogram(name, help, self, buckets))
+
+    def configure(self, enabled: bool) -> None:
+        """Arm/disarm every metric in this registry (the A/B switch the
+        overhead bench and the chain-neutrality test flip)."""
+        self.enabled = bool(enabled)
+
+    def set_common_labels(self, **labels) -> None:
+        """Labels stamped on every exposed sample — e.g. the per-process
+        ``process="3"`` label multiproc workers set so one scrape target
+        per host stays disambiguated (DISTRIBUTED.md)."""
+        self._common = tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+    def reset(self) -> None:
+        """Zero every value (registration survives) — phase-scoped
+        measurement, e.g. bench excluding its warmup traffic."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m._reset()
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {_escape(m.help)}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m._render(self._common))
+        return "\n".join(lines) + "\n"
+
+    def summary(self, prefixes: Optional[Iterable[str]] = None) -> Dict:
+        """Compact dict view (the ``/stats`` merge and the trainer's
+        ``telemetry.jsonl`` lines): counters/gauges as values, histograms
+        as {count, sum, mean, p50, p99}."""
+        pf = tuple(prefixes) if prefixes else None
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: m._summary() for m in metrics
+                if pf is None or m.name.startswith(pf)}
+
+
+REGISTRY = Registry()
+
+# --------------------------------------------------------------------------
+# The metric catalogue (OBSERVABILITY.md documents each entry). Every
+# metric in the process is defined HERE, once — call sites import these
+# objects. scripts/lint_telemetry.py enforces the name grammar and the
+# register-exactly-once rule statically.
+
+# -- serving (eventgpt_tpu/serve.py + cli/serve.py) --
+SERVE_TTFT = REGISTRY.histogram(
+    "egpt_serve_ttft_seconds",
+    "Submit to first committed token, per request")
+SERVE_ITL = REGISTRY.histogram(
+    "egpt_serve_itl_seconds",
+    "Inter-token latency: mean commit gap per row per harvest, "
+    "weighted by tokens (excludes the first token - that is TTFT)",
+    SHORT_BUCKETS)
+SERVE_QUEUE_WAIT = REGISTRY.histogram(
+    "egpt_serve_queue_wait_seconds",
+    "Submit to leaving the admission queue, per request")
+SERVE_LATENCY = REGISTRY.histogram(
+    "egpt_serve_latency_seconds",
+    "Submit to terminal status (any status), per request")
+SERVE_ADMISSION = REGISTRY.histogram(
+    "egpt_serve_admission_seconds",
+    "Host admission stall per scheduler step (encode + prefill + insert)",
+    SHORT_BUCKETS)
+SERVE_SEGMENT = REGISTRY.histogram(
+    "egpt_serve_segment_seconds",
+    "Host time blocked fetching one decode/spec segment (the un-hidden "
+    "device time; pipelined overlap shrinks it, not the device work)",
+    SHORT_BUCKETS)
+SERVE_OCCUPANCY = REGISTRY.histogram(
+    "egpt_serve_batch_occupancy_rows",
+    "Unfrozen rows at segment dispatch (batch utilization)",
+    ROWS_BUCKETS)
+SERVE_REQUESTS = REGISTRY.counter(
+    "egpt_serve_requests_total",
+    "Finished requests by terminal status "
+    "(ok / deadline_exceeded / cancelled / nan_quarantined / engine_fault)")
+SERVE_TOKENS = REGISTRY.counter(
+    "egpt_serve_tokens_total", "Committed (served) tokens")
+SERVE_SEGMENTS = REGISTRY.counter(
+    "egpt_serve_segments_total", "Dispatched decode/spec segments")
+SERVE_HOST_GAP = REGISTRY.counter(
+    "egpt_serve_host_gap_seconds_total",
+    "Host scheduler time between segment fetches (harvest bookkeeping, "
+    "admission prep, dispatch)")
+SERVE_OVERLAP_HIDDEN = REGISTRY.counter(
+    "egpt_serve_overlap_hidden_seconds_total",
+    "Share of the host gap spent while a dispatched segment was "
+    "verifiably still running on the device")
+SERVE_QUEUE_DEPTH = REGISTRY.gauge(
+    "egpt_serve_queue_depth", "Requests waiting in the admission queue")
+SERVE_ACTIVE_ROWS = REGISTRY.gauge(
+    "egpt_serve_active_rows", "Rows holding a live request")
+SERVE_BREAKER_OPEN = REGISTRY.gauge(
+    "egpt_serve_breaker_open",
+    "1 while the circuit breaker refuses work (health=degraded), else 0")
+SERVE_SCHED_FAULTS = REGISTRY.counter(
+    "egpt_serve_scheduler_faults_total",
+    "Scheduler-thread faults survived by the engine")
+SERVE_SCHED_RESTARTS = REGISTRY.counter(
+    "egpt_serve_scheduler_restarts_total",
+    "Scheduler-thread restarts after a fault")
+
+# -- fault injection (eventgpt_tpu/faults.py) --
+FAULT_TRIPS = REGISTRY.counter(
+    "egpt_fault_trips_total",
+    "Armed fault-plan fires, by site and kind (fail / delay)")
+
+# -- training (eventgpt_tpu/train/trainer.py) --
+TRAIN_LOSS = REGISTRY.gauge(
+    "egpt_train_loss", "Mean loss over the last logged accumulation window")
+TRAIN_GRAD_NORM = REGISTRY.gauge(
+    "egpt_train_grad_norm",
+    "Mean global grad norm over the last logged accumulation window")
+TRAIN_STEP_SECONDS = REGISTRY.histogram(
+    "egpt_train_step_seconds",
+    "Wall time per optimizer step (one accumulation window)")
+TRAIN_DATA_WAIT = REGISTRY.histogram(
+    "egpt_train_data_wait_seconds",
+    "Per micro-batch: host wait for data (iterator + host-to-device)",
+    SHORT_BUCKETS)
+TRAIN_COMPUTE = REGISTRY.histogram(
+    "egpt_train_compute_seconds",
+    "Per optimizer step: wall time minus data wait (step dispatch plus "
+    "device wait at readback boundaries - the compute side of the split)",
+    SHORT_BUCKETS)
+TRAIN_STEPS = REGISTRY.counter(
+    "egpt_train_steps_total", "Completed optimizer steps")
+TRAIN_TOKENS = REGISTRY.counter(
+    "egpt_train_tokens_total", "Attention-masked tokens consumed")
+
+
+def configure(enabled: bool) -> None:
+    """Arm/disarm the process-global registry."""
+    REGISTRY.configure(enabled)
+
+
+def enabled() -> bool:
+    return REGISTRY.enabled
+
+
+def serve_summary() -> Dict:
+    """The /stats merge: compact summaries of every serving metric."""
+    return REGISTRY.summary(("egpt_serve_",))
+
+
+class JsonlSink:
+    """Append-per-record JSONL writer (the trainer's ``telemetry.jsonl``):
+    one ``json.dumps`` + append per call, no retained handle, so it is
+    preemption-safe and costs nothing when unused."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def write(self, record: Dict) -> None:
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")

@@ -41,6 +41,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
+# jax 0.4.x ships the Mosaic compile options as TPUCompilerParams; newer
+# releases renamed it to CompilerParams. Same fields either way.
+_CompilerParams = (getattr(pltpu, "CompilerParams", None)
+                   or pltpu.TPUCompilerParams)
+
 
 def _decode_attn_kernel(li_ref, nv_ref, q_ref, kq_ref, ks_ref, vq_ref,
                         vs_ref, o_ref, *, scale: float, block_kv: int):
@@ -134,7 +139,7 @@ def decode_attention_int8(
         interpret=interpret,
         # Double-buffered int8 blocks + per-head cast temps exceed the 16 MB
         # default scoped-VMEM budget at S ~ 1200; v5e has 128 MB VMEM.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=64 * 1024 * 1024
         ),
     )(jnp.asarray(li, jnp.int32).reshape(1), jnp.asarray(n_valid, jnp.int32),

@@ -136,6 +136,14 @@ def worker_main() -> None:
     rank = jax.process_index()
     nproc = jax.process_count()
 
+    # Per-process metric labels (ISSUE 3 / DISTRIBUTED.md): every sample a
+    # worker exposes (or dumps into telemetry.jsonl) carries its rank, so
+    # scrapes from N processes on one host stay disambiguated without any
+    # name mangling. The same call is the pattern for real pod launches.
+    from eventgpt_tpu.obs import metrics as _obs_metrics
+
+    _obs_metrics.REGISTRY.set_common_labels(process=str(rank))
+
     mesh_shape = [int(x) for x in os.environ["EGPT_MP_MESH"].split(",")]
     n_steps = int(os.environ.get("EGPT_MP_STEPS", "2"))
     outdir = os.environ["EGPT_MP_OUTDIR"]

@@ -69,6 +69,9 @@ from jax import lax
 
 from eventgpt_tpu import faults
 from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.obs import metrics as obs_metrics
+from eventgpt_tpu.obs import profiling as obs_profiling
+from eventgpt_tpu.obs import trace as obs_trace
 from eventgpt_tpu.constants import SEQ_BUCKET
 from eventgpt_tpu.models import eventchat, llama as llama_mod
 from eventgpt_tpu.ops.sampling import sample
@@ -546,6 +549,13 @@ class _Request:
     t_submit: float = 0.0
     t_first: Optional[float] = None
     t_done: Optional[float] = None
+    # Last harvest that committed tokens for this row (inter-token-latency
+    # telemetry: gaps between consecutive harvests, weighted by tokens).
+    t_last: Optional[float] = None
+    # Telemetry phase of the request's async trace span: "queued" until it
+    # leaves the admission queue, then "active"; _record_finish closes
+    # whichever is open (obs/trace.py request-lifecycle events).
+    phase: str = "queued"
     # Absolute perf_counter deadline (None = no deadline). Enforced both
     # while queued and between decode segments: an expired row is frozen
     # and finished with STATUS_DEADLINE instead of burning its budget.
@@ -1153,6 +1163,9 @@ class ContinuousBatcher:
             req.deadline = req.t_submit + float(deadline_s)
             self._n_deadlines += 1
         self.queue.append(req)
+        obs_metrics.SERVE_QUEUE_DEPTH.set(len(self.queue))
+        obs_trace.async_begin("queued", rid,
+                              prompt_len=prompt_len, budget=max_new_tokens)
         return rid
 
     def cancel(self, rid: int) -> bool:
@@ -1262,10 +1275,18 @@ class ContinuousBatcher:
             self._drain()
         self._expire_deadlines()
         t0 = time.perf_counter()
-        self._admit()
+        admitted = self._admit()
         dt_admit = time.perf_counter() - t0
         self.admission_s += dt_admit
         self.admission_max_s = max(self.admission_max_s, dt_admit)
+        if admitted:
+            # Only steps that did admission work (popped the queue or
+            # advanced a pending chunked prefill) are observed — no-op
+            # probes would drown the stall distribution in microseconds.
+            obs_metrics.SERVE_ADMISSION.observe(dt_admit)
+            tr = obs_trace.active()
+            if tr is not None:
+                tr.complete("admit", t0, t0 + dt_admit, cat="sched")
         if all(r is None for r in self.rows):
             self._drain()  # trailing all-frozen segment, if any
             return
@@ -1409,6 +1430,16 @@ class ContinuousBatcher:
                 )
         rec = {"chunk": chunk, "frozen_in": frozen,
                "wait_at_dispatch": self.device_segment_s}
+        if record_carry:
+            # Warmup's all-frozen compile dispatches pass record_carry=False
+            # and stay out of the telemetry the same way they stay out of
+            # the overlap counters.
+            obs_metrics.SERVE_SEGMENTS.inc()
+            obs_metrics.SERVE_OCCUPANCY.observe(
+                int(self.max_batch - int(self.frozen.sum())))
+        t_disp0 = time.perf_counter()
+        _ann = obs_profiling.annotation("serve.segment_dispatch")
+        _ann.__enter__()
         if self.speculative:
             n_iters = max(1, chunk // self.speculative)
             history = (jnp.asarray(self._history.astype(np.int32))
@@ -1480,7 +1511,12 @@ class ContinuousBatcher:
         if record_carry:
             self._dev_carry = (frozen_out, n_rem_out, base_pos_out)
             self.seg_count += 1
+        _ann.__exit__(None, None, None)
         rec["t_dispatch"] = time.perf_counter()
+        tr = obs_trace.active()
+        if tr is not None:
+            tr.complete("dispatch", t_disp0, rec["t_dispatch"], cat="sched",
+                        args={"chunk": chunk})
         return rec
 
     def _harvest_segment(self, rec: dict) -> None:
@@ -1491,7 +1527,9 @@ class ContinuousBatcher:
         carry, so no re-upload is needed on this path."""
         t_fetch = time.perf_counter()
         if self._t_prev_fetch_end is not None:
-            self.host_gap_s += t_fetch - self._t_prev_fetch_end
+            gap = t_fetch - self._t_prev_fetch_end
+            self.host_gap_s += gap
+            obs_metrics.SERVE_HOST_GAP.inc(gap)
         if self.speculative:
             new_np, it_v, n_new, done, frozen_in = jax.device_get(
                 (rec["gather"], rec["it"], rec["n_new"], rec["done"],
@@ -1519,10 +1557,18 @@ class ContinuousBatcher:
             # it spent blocked fetching the previous segment — ran hidden
             # behind device compute.
             blocked_since = self.device_segment_s - rec["wait_at_dispatch"]
-            self.overlap_hidden_s += max(
-                0.0, t_fetch - rec["t_dispatch"] - blocked_since
-            )
+            hidden = max(0.0, t_fetch - rec["t_dispatch"] - blocked_since)
+            self.overlap_hidden_s += hidden
+            obs_metrics.SERVE_OVERLAP_HIDDEN.inc(hidden)
         self.device_segment_s += wait
+        obs_metrics.SERVE_SEGMENT.observe(wait)
+        tr = obs_trace.active()
+        if tr is not None:
+            # The fetch block IS the visible device time: one span per
+            # segment, so Perfetto shows the un-hidden device share
+            # against the dispatch/harvest host spans.
+            tr.complete("segment_fetch", t_fetch, t_end, cat="sched",
+                        args={"wait_s": round(wait, 6)})
         self._t_prev_fetch_end = t_end
         if self.speculative:
             self.spec_iterations += int(it_v)
@@ -1551,8 +1597,19 @@ class ContinuousBatcher:
                 self.base_pos[r] += int(n_new[r])
             else:
                 new = tokens[r, : n_new[r]]
-            if len(new) and req.t_first is None:
-                req.t_first = now
+            if len(new):
+                if req.t_first is None:
+                    req.t_first = now
+                elif req.t_last is not None:
+                    # Inter-token latency: tokens land in harvest-sized
+                    # groups, so the observable per-token gap is the mean
+                    # over this harvest interval, weighted by its token
+                    # count. A row's FIRST harvest is excluded — those
+                    # gaps live inside TTFT.
+                    obs_metrics.SERVE_ITL.observe(
+                        (now - req.t_last) / len(new), n=len(new))
+                req.t_last = now
+                obs_metrics.SERVE_TOKENS.inc(len(new))
             req.tokens.extend(int(t) for t in new)
             self.n_rem[r] -= int(n_new[r])
             if done[r] or self.n_rem[r] <= 0:
@@ -1599,11 +1656,25 @@ class ContinuousBatcher:
             self.request_stats.pop(next(iter(self.request_stats)))
         while len(self.finish_status) >= 8192:
             self.finish_status.pop(next(iter(self.finish_status)))
+        ttft = (req.t_first if req.t_first is not None
+                else req.t_done) - req.t_submit
+        latency = req.t_done - req.t_submit
         self.request_stats[req.rid] = {
-            "ttft_s": (req.t_first if req.t_first is not None
-                       else req.t_done) - req.t_submit,
-            "latency_s": req.t_done - req.t_submit,
+            "ttft_s": ttft,
+            "latency_s": latency,
         }
+        if req.t_first is not None:
+            # Forced finishes that never committed a token (expired in the
+            # queue, cancelled pre-admission) have no first token; their
+            # t_done stand-in would pollute the TTFT distribution.
+            obs_metrics.SERVE_TTFT.observe(ttft)
+        obs_metrics.SERVE_LATENCY.observe(latency)
+        obs_metrics.SERVE_REQUESTS.inc(status=status)
+        obs_metrics.SERVE_ACTIVE_ROWS.set(
+            sum(r is not None for r in self.rows))
+        obs_metrics.SERVE_QUEUE_DEPTH.set(len(self.queue))
+        obs_trace.async_end(req.phase, req.rid, status=status,
+                            tokens=len(ids))
         if status == STATUS_OK:
             self._history_append(ids)
         self.finished[req.rid] = ids
@@ -1625,17 +1696,30 @@ class ContinuousBatcher:
             self._history[:-len(arr)] = self._history[len(arr):]
             self._history[-len(arr):] = arr
 
-    def _admit(self) -> None:
+    def _admit(self) -> bool:
+        """Returns True when this step did admission work (advanced a
+        pending chunked prefill or popped the queue) — the telemetry
+        gate for the admission-stall histogram."""
         from eventgpt_tpu.models.eventchat import _prefill_jit, _prefill_sharded
 
         faults.maybe_fail("serve.admit")
         faults.maybe_delay("serve.admit")
+        did_work = False
         if self._pending is not None:
+            did_work = True
             self._advance_pending()
         while (self._pending is None and self.queue
                and any(self.rows[r] is None
                        for r in range(self.max_batch))):
             req = self.queue.popleft()
+            did_work = True
+            obs_metrics.SERVE_QUEUE_DEPTH.set(len(self.queue))
+            obs_metrics.SERVE_QUEUE_WAIT.observe(
+                time.perf_counter() - req.t_submit)
+            if req.phase == "queued":
+                obs_trace.async_end("queued", req.rid)
+                obs_trace.async_begin("active", req.rid)
+                req.phase = "active"
             row = next(r for r in range(self.max_batch)
                        if self.rows[r] is None)
             suffix_ids = self._prefix_suffix_ids(req)
@@ -1683,6 +1767,7 @@ class ContinuousBatcher:
                 row_logits, row_cache = pre
             self._finish_admission(req, row, prompt_len, row_cache,
                                    row_logits, row_hidden)
+        return did_work
 
     def _prep_request(self, req: _Request):
         """Host + encode prep for one admission: CLIP encode, splice, pad
@@ -1795,6 +1880,8 @@ class ContinuousBatcher:
         )
         self.rows[row] = req
         req.row = row
+        obs_metrics.SERVE_ACTIVE_ROWS.set(
+            sum(r is not None for r in self.rows))
         # Row activation below rewrites frozen/n_rem (and base_pos for
         # speculative rows): the next dispatch re-uploads the host mirror.
         # _admit only runs drained, so the mirror is settled here.
@@ -1848,6 +1935,7 @@ class ContinuousBatcher:
         self.key, sub = jax.random.split(self.key)
         t0 = int(sample(row_logits, sub, self.temperature, self.top_p)[0])
         req.t_first = time.perf_counter()
+        req.t_last = req.t_first
         self.ids_buf = (
             self.ids_buf.at[row].set(-1)
             .at[row, : len(row_ids)].set(jnp.asarray(row_ids))

@@ -95,6 +95,17 @@ class TrainingArguments:
     # at least this often (seconds) while steps complete, so watchdogs can
     # pick a staleness timeout without knowing the logging config.
     heartbeat_interval_s: float = 30.0
+    # Telemetry (ISSUE 3, OBSERVABILITY.md): per-optimizer-step JSONL
+    # (output_dir/telemetry.jsonl) with the data-wait vs compute split and
+    # the egpt_train_* registry summary. Off = zero extra host work.
+    telemetry: bool = True
+    # jax.profiler capture: a non-empty dir arms StepTraceAnnotation around
+    # every micro-step and captures optimizer steps
+    # [profile_start_step, profile_start_step + profile_num_steps) into it
+    # (start > 1 so compile stays out of the window).
+    profile_dir: str = ""
+    profile_start_step: int = 2
+    profile_num_steps: int = 2
     # Mesh
     mesh_data: int = -1                 # -1 -> auto (best_mesh_config)
     mesh_fsdp: int = -1

@@ -23,6 +23,8 @@ from eventgpt_tpu import checkpoint as ckpt
 from eventgpt_tpu import constants
 from eventgpt_tpu import faults
 from eventgpt_tpu.config import EventChatConfig, MeshConfig
+from eventgpt_tpu.obs import metrics as obs_metrics
+from eventgpt_tpu.obs import profiling as obs_profiling
 from eventgpt_tpu.parallel import best_mesh_config, make_mesh, shard_params
 from eventgpt_tpu.parallel.dist import is_primary
 from eventgpt_tpu.parallel.sharding import (
@@ -292,6 +294,19 @@ class Trainer:
         )
         self.eval_step = steps_mod.make_eval_step(cfg, self.combine, mesh=mesh)
         self.metrics_path = os.path.join(train_args.output_dir, "metrics.jsonl")
+        # Telemetry (ISSUE 3): per-OPTIMIZER-step JSONL — wall time split
+        # into data-wait vs compute plus the egpt_train_* registry summary;
+        # metrics.jsonl stays the sparse human log it always was.
+        self.telemetry = (
+            obs_metrics.JsonlSink(
+                os.path.join(train_args.output_dir, "telemetry.jsonl"))
+            if train_args.telemetry else None
+        )
+        self._profiling = False
+        if train_args.profile_dir:
+            # Arms StepTraceAnnotation around every micro-step; the actual
+            # capture window opens at profile_start_step (_maybe_profile).
+            obs_profiling.configure(train_args.profile_dir)
         self.heartbeat = Heartbeat(train_args.output_dir)
         self._last_ckpt: Optional[str] = None
         if train_args.on_divergence not in ("raise", "rewind"):
@@ -457,6 +472,30 @@ class Trainer:
         finally:
             if own_shutdown:
                 shutdown.uninstall()
+            if self._profiling:
+                # Training ended (or died) inside the capture window:
+                # close the profiler trace so the dump is loadable.
+                obs_profiling.stop_trace()
+                self._profiling = False
+
+    def _maybe_profile(self, step: int) -> None:
+        """Open/close the --profile_dir capture window at optimizer-step
+        boundaries: steps [profile_start_step, +profile_num_steps) run
+        inside one jax.profiler trace (start > 1 keeps compile out)."""
+        targs = self.targs
+        if not targs.profile_dir:
+            return
+        start = max(int(targs.profile_start_step), 1)
+        stop = start + max(int(targs.profile_num_steps), 1)
+        if not self._profiling and step + 1 == start:
+            obs_profiling.start_trace(targs.profile_dir)
+            self._profiling = True
+            self._log({"event": "profile_start", "step": step + 1,
+                       "dir": targs.profile_dir})
+        elif self._profiling and step + 1 >= stop:
+            obs_profiling.stop_trace()
+            self._profiling = False
+            self._log({"event": "profile_stop", "step": step})
 
     def _train_loop(self, shutdown: GracefulShutdown) -> Dict[str, float]:
         targs = self.targs
@@ -505,10 +544,25 @@ class Trainer:
                 # producer on every exit path (preempt, divergence, done).
                 it = PrefetchIterator(it, depth=targs.prefetch_depth)
             window: list = []  # (loss, grad_norm) device scalars, one per micro
+            win_data_wait = 0.0  # host-blocked-on-data share of the window
             t_window = time.perf_counter()
             diverged = False
+            self._maybe_profile(step)
+
+            def timed_iter(src):
+                # Iterator wait measured per micro-batch without touching
+                # the loop's continue-paths: (seconds_waiting, batch).
+                src = iter(src)
+                while True:
+                    t0 = time.perf_counter()
+                    try:
+                        x = next(src)
+                    except StopIteration:
+                        return
+                    yield time.perf_counter() - t0, x
+
             try:
-                for host_batch in it:
+                for dt_iter, host_batch in timed_iter(it):
                     # Micro-batch-boundary fault site: a chaos test can
                     # kill or slow any step deterministically and assert
                     # the preemption/divergence/heartbeat story holds.
@@ -530,10 +584,17 @@ class Trainer:
                         self._log({"event": "preempt", "reason": shutdown.reason,
                                    "step": step})
                         return last_metrics
+                    t0 = time.perf_counter()
                     batch = steps_mod.batch_to_device(host_batch, self.mesh)
-                    self.state, metrics = self.train_step(self.state, batch)
+                    dt_data = dt_iter + (time.perf_counter() - t0)
+                    win_data_wait += dt_data
+                    obs_metrics.TRAIN_DATA_WAIT.observe(dt_data)
+                    with obs_profiling.step_annotation(micro):
+                        self.state, metrics = self.train_step(self.state, batch)
                     micro += 1
-                    tokens_seen += int(host_batch["attn_mask"].sum())
+                    tok_n = int(host_batch["attn_mask"].sum())
+                    tokens_seen += tok_n
+                    obs_metrics.TRAIN_TOKENS.inc(tok_n)
                     window.append((metrics["loss"], metrics["grad_norm"]))
                     if micro % accum:
                         continue  # gradients still accumulating
@@ -583,6 +644,35 @@ class Trainer:
                                 "tokens_per_s": round(tokens_seen / (time.perf_counter() - t_start), 1),
                             }
                             self._log(last_metrics)
+                    # -- telemetry: per-optimizer-step JSONL + registry --
+                    # step_wall splits into data-wait (host blocked on the
+                    # iterator / host-to-device) and compute (everything
+                    # else: step dispatch, device wait at readbacks).
+                    step_wall = time.perf_counter() - t_window
+                    compute_s = max(step_wall - win_data_wait, 0.0)
+                    obs_metrics.TRAIN_STEP_SECONDS.observe(step_wall)
+                    obs_metrics.TRAIN_COMPUTE.observe(compute_s)
+                    obs_metrics.TRAIN_STEPS.inc()
+                    if need_log:
+                        obs_metrics.TRAIN_LOSS.set(loss)
+                        obs_metrics.TRAIN_GRAD_NORM.set(gnorm)
+                    if self.telemetry is not None and is_primary():
+                        rec = {"step": step, "micro": micro,
+                               "step_wall_s": round(step_wall, 6),
+                               "data_wait_s": round(win_data_wait, 6),
+                               "compute_s": round(compute_s, 6),
+                               "tokens_seen": tokens_seen}
+                        if need_log:
+                            rec["loss"] = loss
+                            rec["grad_norm"] = gnorm
+                        # The registry view rides along so the JSONL is
+                        # self-contained (same numbers /metrics would
+                        # expose on a server).
+                        rec["registry"] = obs_metrics.REGISTRY.summary(
+                            ("egpt_train_",))
+                        self.telemetry.write(rec)
+                    self._maybe_profile(step)
+                    win_data_wait = 0.0
                     window.clear()
                     t_window = time.perf_counter()
                     # Liveness beat on its own time cadence (not logging_steps):

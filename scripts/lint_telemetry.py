@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Static telemetry lint (ISSUE 3 satellite; the fast tier runs it via
+``tests/test_lint_telemetry.py``, or run it directly: prints violations
+and exits non-zero when any exist).
+
+Rule 1 — hot paths use ``time.perf_counter``, never ``time.time``:
+wall-clock jumps (NTP slews, suspend/resume) would corrupt latency
+histograms, deadlines and the pipelined-overlap accounting. Hot paths
+are the serving scheduler, the obs package itself, the fault probes, the
+jitted-step helpers, prefetch, and the kernels. Deliberate wall-clock
+users stay OFF this list: ``train/resilience.py`` stamps heartbeat files
+with epoch time for EXTERNAL watchdogs, and ``cli/serve.py``'s uptime is
+human-facing.
+
+Rule 2 — metric registration: every ``.counter(``/``.gauge(``/
+``.histogram(`` call with a string-literal name uses a name matching
+``egpt_[a-z0-9_]+``, and each name is registered exactly once across the
+runtime tree (the obs/metrics.py central-catalogue rule: call sites
+import metric objects, they never register). Tests are excluded — they
+build private registries with throwaway names.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List
+
+HOT_PATHS = (
+    "eventgpt_tpu/serve.py",
+    "eventgpt_tpu/faults.py",
+    "eventgpt_tpu/obs/",
+    "eventgpt_tpu/train/steps.py",
+    "eventgpt_tpu/train/prefetch.py",
+    "eventgpt_tpu/ops/",
+)
+# Trees scanned for metric registrations (rule 2). tests/ is excluded on
+# purpose: private test registries use throwaway names.
+METRIC_SCAN = ("eventgpt_tpu", "scripts", "bench.py")
+
+METRIC_NAME_RE = re.compile(r"^egpt_[a-z0-9_]+$")
+_REG_RE = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*['\"]([A-Za-z0-9_.:-]+)['\"]")
+
+
+def _is_hot(rel: str) -> bool:
+    return any(rel == h or (h.endswith("/") and rel.startswith(h))
+               for h in HOT_PATHS)
+
+
+def _py_files(root: str) -> List[str]:
+    out = []
+    for scan in METRIC_SCAN:
+        p = os.path.join(root, scan)
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, _, files in os.walk(p):
+            out.extend(os.path.join(dirpath, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    return sorted(out)
+
+
+def _check_time_time(rel: str, tree: ast.AST, out: List[str]) -> None:
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "time"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "time"):
+            out.append(f"{rel}:{node.lineno}: time.time() in a hot path "
+                       f"(use time.perf_counter)")
+        if (isinstance(node, ast.ImportFrom) and node.module == "time"
+                and any(a.name == "time" for a in node.names)):
+            out.append(f"{rel}:{node.lineno}: 'from time import time' in "
+                       f"a hot path (use time.perf_counter)")
+
+
+def run_lint(root: str) -> List[str]:
+    """Returns the violation list (empty = clean)."""
+    violations: List[str] = []
+    seen: Dict[str, str] = {}  # metric name -> first registration site
+    for path in _py_files(root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path) as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, rel)
+        except SyntaxError as e:
+            violations.append(f"{rel}: unparseable ({e})")
+            continue
+        if _is_hot(rel):
+            _check_time_time(rel, tree, violations)
+        for m in _REG_RE.finditer(src):
+            # \s crosses newlines: registrations wrap the name to the
+            # line after the call in the catalogue's house style.
+            name = m.group(1)
+            site = f"{rel}:{src.count(chr(10), 0, m.start()) + 1}"
+            if not METRIC_NAME_RE.match(name):
+                violations.append(
+                    f"{site}: metric name {name!r} does not match "
+                    f"{METRIC_NAME_RE.pattern}")
+            if name in seen:
+                violations.append(
+                    f"{site}: metric {name!r} registered twice "
+                    f"(first at {seen[name]}) — define metrics once, "
+                    f"in obs/metrics.py")
+            else:
+                seen[name] = site
+    if not seen:
+        violations.append("no metric registrations found — the scan "
+                          "pattern or tree layout changed under the lint")
+    return violations
+
+
+def main() -> int:
+    root = sys.argv[1] if len(sys.argv) > 1 else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    violations = run_lint(root)
+    for v in violations:
+        print(v)
+    print(f"lint_telemetry: {len(violations)} violation(s)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""Fast-tier wiring for ``scripts/lint_telemetry.py``: the repo must stay
+clean (no ``time.time()`` in hot paths, every metric name well-formed and
+registered exactly once), and the lint itself must still catch each
+violation class (a lint that silently stopped matching would "pass"
+forever)."""
+
+import importlib.util
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint():
+    spec = importlib.util.spec_from_file_location(
+        "lint_telemetry", os.path.join(ROOT, "scripts", "lint_telemetry.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_is_clean():
+    assert _lint().run_lint(ROOT) == []
+
+
+def test_lint_catches_each_violation_class(tmp_path):
+    lint = _lint()
+    pkg = tmp_path / "eventgpt_tpu"
+    (pkg / "obs").mkdir(parents=True)
+    # Hot path with both time.time forms.
+    (pkg / "serve.py").write_text(
+        "import time\n"
+        "from time import time as _t\n"
+        "def f():\n"
+        "    return time.time()\n"
+    )
+    # Bad metric name + a duplicate registration across files.
+    (pkg / "obs" / "metrics.py").write_text(
+        'R.counter("Bad-Name", "x")\n'
+        'R.gauge(\n    "egpt_ok_metric", "x")\n'
+    )
+    (pkg / "other.py").write_text('R.gauge("egpt_ok_metric", "again")\n')
+    v = lint.run_lint(str(tmp_path))
+    assert any("time.time()" in s for s in v)
+    assert any("from time import time" in s for s in v)
+    assert any("'Bad-Name' does not match" in s for s in v)
+    assert any("registered twice" in s for s in v)
+
+
+def test_lint_fails_closed_when_nothing_found(tmp_path):
+    # An empty tree means the scan itself broke — that must be a
+    # violation, not a pass.
+    v = _lint().run_lint(str(tmp_path))
+    assert any("no metric registrations" in s for s in v)
