@@ -419,12 +419,34 @@ def run_serve(args):
         prefill_chunk=args.serve_prefill_chunk,
         first_chunk=args.serve_first_chunk or 0,
         pipeline=bool(args.serve_pipeline),
+        prefix_cache=bool(args.serve_prefix_cache),
+        prefix_insert=bool(args.serve_cache_insert),
     )
-    if args.serve_prefix:
+    # Multi-session traffic (ISSUE 4): --serve_sessions S > 0 serves S
+    # distinct event streams round-robin — the prefix cache's target
+    # shape (repeated system-prompt + per-session event-block heads).
+    # S == 0 keeps the single-stream legacy traffic.
+    sessions = max(int(args.serve_sessions), 0)
+    if sessions:
+        rngs = [np.random.default_rng(1000 + s) for s in range(sessions)]
+        shape = (cfg.num_event_frames, 3, cfg.vision.image_size,
+                 cfg.vision.image_size)
+        session_pixels = [r.normal(size=shape).astype(np.float32)
+                          for r in rngs]
+    else:
+        session_pixels = [pixels]
+    if args.serve_prefix or (
+            sessions and bool(args.serve_prefix_cache)
+            and bool(args.serve_cache_insert)):
         # Session-style shared prefix: system text + the event block
         # (every request in this leg shares the stream); admissions
         # prefill only the 16-token query tail and skip CLIP encode.
-        srv.set_prefix(ids[: 1 + 34 + 1], pixel_values=pixels)
+        # The multi-session auto-cache legs install it too, BEFORE
+        # warmup: the measured traffic recreates the same entry shapes,
+        # and warmup() can only precompile suffix executables for
+        # entries that exist — without this the cold window pays the
+        # _prefix_prefill XLA compile on its first hit.
+        srv.set_prefix(ids[: 1 + 34 + 1], pixel_values=session_pixels[0])
     t0 = time.perf_counter()
     warmed = srv.warmup(prompt_lens=[prompt_len]) if args.warmup else 0
     t_warm = time.perf_counter() - t0
@@ -432,16 +454,42 @@ def run_serve(args):
     # First request on the fresh server: with --warmup this must cost
     # steady-state latency (nothing left to compile or load mid-service).
     t0 = time.perf_counter()
-    r0 = srv.submit(ids, pixels, args.decode_tokens)
+    r0 = srv.submit(ids, session_pixels[0], args.decode_tokens)
     first = srv.run_until_drained()
     t_first_req = time.perf_counter() - t0
     assert len(first[r0]) == args.decode_tokens
 
+    def _fresh_cache():
+        if (srv._prefix_cache is not None and sessions
+                and bool(args.serve_cache_insert)):
+            # Auto-populated cache: drop the warmup/priming entries so
+            # the window that follows counts its cold misses honestly.
+            # (Skipped when insert-on-prefill is off — there the
+            # operator-set entry IS the leg being measured.)
+            srv._prefix_cache = type(srv._prefix_cache)(
+                srv._prefix_cache.budget)
+
+    if sessions and args.warmup:
+        # Wave-executable priming (unmeasured): batcher.warmup() cannot
+        # know the wave shapes traffic will produce, so replay the
+        # measured window's cold trajectory once against a fresh cache —
+        # burst 1 of S requests MISSES together (compiles the batched
+        # encode + miss-wave prefill + scatter), burst 2 HITS together
+        # (compiles the batched suffix wave). The measured window below
+        # then pays zero XLA compile, like every other warmed leg.
+        _fresh_cache()
+        for burst in range(2):
+            for i in range(min(sessions, srv.max_batch)):
+                srv.submit(ids, session_pixels[i % len(session_pixels)], 4)
+            srv.run_until_drained()
+
     srv.reset_serving_stats()  # exclude the warmup/first-request phase
+    _fresh_cache()
     obs_metrics.REGISTRY.reset()  # same phase scoping for the registry
     t0 = time.perf_counter()
-    rids = [srv.submit(ids, pixels, args.decode_tokens)
-            for _ in range(n_req)]
+    rids = [srv.submit(ids, session_pixels[i % len(session_pixels)],
+                       args.decode_tokens)
+            for i in range(n_req)]
     out = srv.run_until_drained()
     dt = time.perf_counter() - t0
     tot = sum(len(out[r]) for r in rids)
@@ -462,6 +510,21 @@ def run_serve(args):
         "latency_p99_s": round(float(np.percentile(lats, 99)), 3),
         "first_chunk": args.serve_first_chunk or 0,
         "prefix_reuse": bool(args.serve_prefix),
+        # Prefix-KV cache story (ISSUE 4): hit ratio over the measured
+        # window (batcher-level counters — they count with telemetry
+        # disarmed too), plus the admission-dispatch shape below when
+        # the registry is armed.
+        "sessions": sessions,
+        "prefix_cache": bool(args.serve_prefix_cache),
+        "prefix_cache_insert": bool(args.serve_cache_insert),
+        **({k: v for k, v in [
+            ("prefix_cache_hit_ratio",
+             round(srv.prefix_cache_stats().get("hit_ratio", 0.0), 3)),
+            ("prefix_cache_entries",
+             srv.prefix_cache_stats().get("n_entries", 0)),
+            ("prefix_cache_evictions",
+             srv.prefix_cache_stats().get("evictions", 0)),
+        ]} if args.serve_prefix_cache else {}),
         # Pipelined-scheduler overlap story (host-observable; definitions
         # in PERFORMANCE.md "Pipelined scheduling"): host_gap_s is the
         # host scheduler time between segments, device_segment_s the time
@@ -499,7 +562,24 @@ def run_serve(args):
             "egpt_serve_ttft_seconds", "egpt_serve_itl_seconds",
             "egpt_serve_queue_wait_seconds", "egpt_serve_segment_seconds",
             "egpt_serve_batch_occupancy_rows",
+            "egpt_serve_prefix_cache_", "egpt_serve_admission_wave_rows",
         ))
+        # Admission-dispatch shape (ISSUE 4): counter-verified from the
+        # same egpt_* registry a live server scrapes — N queued
+        # admissions should cost ~1 "wave" dispatch, not N "full" ones,
+        # and cache hits should move dispatches into the cheap "suffix"
+        # bucket.
+        disp = obs_metrics.SERVE_PREFILL_DISPATCHES
+        record["prefill_dispatches"] = {
+            k: int(disp.value(kind=k))
+            for k in ("full", "wave", "chunk", "suffix", "suffix_wave")
+            if disp.value(kind=k)
+        }
+        record["prefill_dispatches_total"] = int(disp.total())
+        wave_summary = obs_metrics.SERVE_ADMISSION_WAVE._summary()
+        record["admission_wave_size_mean"] = round(
+            float(wave_summary.get("mean", 0.0)), 2)
+        record["admission_waves"] = int(wave_summary.get("count", 0))
     print(json.dumps(record))
     return record
 
@@ -1027,6 +1107,36 @@ def run_all(args):
         except Exception as e:
             sys.stderr.write(f"serve b{width} prefix leg failed: {e}\n")
 
+    # Multi-session prefix-cache legs (ISSUE 4): S distinct event streams
+    # round-robin — the radix cache's target traffic. Three-way A/B on
+    # IDENTICAL traffic: cache on (auto insert-on-prefill), the r5
+    # single-slot emulation (one operator entry, no auto-insert), and
+    # cache off (full prefill per request). The BENCH json carries the
+    # hit ratio, the dispatch-count shape (wave vs full vs suffix) and
+    # the wave-size histogram for each.
+    ms_base = ["--mode", "serve", "--preset", args.preset,
+               "--quant", args.quant, "--decode_tokens", "128",
+               "--serve_requests", "16", "--serve_batch", "4",
+               "--kv", "int8", "--warmup", "1", "--serve_sessions", "4"]
+    for tag, extra in (
+        ("", ["--serve_prefix_cache", "1"]),
+        ("_slot", ["--serve_prefix_cache", "1", "--serve_cache_insert", "0",
+                   "--serve_prefix", "1"]),
+        ("_nocache", ["--serve_prefix_cache", "0"]),
+    ):
+        try:
+            sv = _leg(ms_base + extra)
+            record[f"serve_ms4{tag}_tok_s"] = sv["value"]
+            record[f"serve_ms4{tag}_ttft_p50_s"] = sv["ttft_p50_s"]
+            if "prefix_cache_hit_ratio" in sv:
+                record[f"serve_ms4{tag}_hit_ratio"] = \
+                    sv["prefix_cache_hit_ratio"]
+            if "prefill_dispatches" in sv:
+                record[f"serve_ms4{tag}_prefill_dispatches"] = \
+                    sv["prefill_dispatches"]
+        except Exception as e:
+            sys.stderr.write(f"serve ms4{tag} leg failed: {e}\n")
+
     print(json.dumps(record))
 
 
@@ -1061,6 +1171,18 @@ def main() -> None:
                    help="mode=serve: 1 = set a shared system+event prefix "
                         "(set_prefix) so admissions prefill only the query "
                         "tail")
+    p.add_argument("--serve_sessions", type=int, default=0,
+                   help="mode=serve: number of DISTINCT event streams the "
+                        "requests round-robin over (0 = single stream); "
+                        "the prefix-KV cache's multi-session traffic shape")
+    p.add_argument("--serve_prefix_cache", type=int, default=1,
+                   help="mode=serve: 1 (default) = prefix-KV cache armed "
+                        "(auto insert-on-prefill + longest-prefix match); "
+                        "0 = disabled, for cache A/B runs")
+    p.add_argument("--serve_cache_insert", type=int, default=1,
+                   help="mode=serve: 0 disables insert-on-prefill (cache "
+                        "holds only operator-set entries — the r5 single-"
+                        "slot behavior, for regression comparison)")
     p.add_argument("--serve_telemetry", type=int, default=1,
                    help="mode=serve: 1 (default) = metrics registry armed "
                         "(TTFT/ITL distributions recorded in the BENCH "

@@ -24,6 +24,8 @@ Endpoints:
       (lock-free snapshot: answers inside a probe timeout even mid-segment)
   GET  /stats        -> serverwide counters + recent request stats +
       a summary of the telemetry registry (obs/metrics.py).
+  GET  /prefix_cache -> prefix-KV cache snapshot (entries, bytes,
+      hit/miss/eviction counters); POST /prefix inserts an entry.
   GET  /metrics      -> Prometheus text exposition (scrape target:
       TTFT / inter-token-latency / queue-wait histograms, counters,
       breaker state — the catalogue is in OBSERVABILITY.md).
@@ -378,6 +380,13 @@ class ServingEngine:
                 b.rows[r] = None
                 b.frozen[r] = True
                 b.n_rem[r] = 0
+                ent = getattr(req, "prefix_entry", None)
+                if ent is not None:
+                    # The sweep bypasses _record_finish: drain the
+                    # prefix-cache refcount pin here or the entry would
+                    # stay unevictable forever.
+                    ent.pins -= 1
+                    req.prefix_entry = None
                 failed.append(req.rid)
             b._pending = None
             if tripped:
@@ -517,6 +526,11 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                                  "restarts": engine.n_restarts})
             elif self.path == "/stats":
                 self._json(200, engine.stats())
+            elif self.path == "/prefix_cache":
+                # Prefix-KV cache snapshot (ISSUE 4): entry list, byte
+                # budget/usage, hit/miss/eviction counters. Lock-free
+                # like /stats — the cache guards its own host-side state.
+                self._json(200, engine.batcher.prefix_cache_stats())
             elif self.path == "/metrics":
                 # Prometheus text exposition (scrape target). Rendering
                 # walks the registry outside the engine lock — safe inside
@@ -609,11 +623,13 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                                  "cancelled": engine.cancel(rid)})
                 return
             if self.path == "/prefix":
-                # Admin route (VERDICT residue): install the shared-prefix
-                # KV seed on a RUNNING server — {"prefix_prompt": str,
-                # optional "event_path"/"event_b64" when the prefix runs
-                # through the event block}. Matching admissions skip the
-                # prefix's encode + prefill from then on.
+                # Admin route: INSERT a prefix-KV cache entry on a
+                # RUNNING server — {"prefix_prompt": str, optional
+                # "event_path"/"event_b64" when the prefix runs through
+                # the event block}. Since ISSUE 4 the cache is a
+                # multi-entry trie, so repeated POSTs accumulate entries
+                # (same key = replace) next to the ones admission prefill
+                # inserts automatically; GET /prefix_cache lists them.
                 try:
                     payload = json.loads(self.rfile.read(n) or b"{}")
                     prompt = payload["prefix_prompt"]
@@ -627,7 +643,10 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                 except Exception as e:
                     self._json(500, {"error": str(e)})
                     return
-                self._json(200, {"prefix_len": plen})
+                st = engine.batcher.prefix_cache_stats()
+                self._json(200, {"prefix_len": plen,
+                                 "entries": st.get("n_entries", 0),
+                                 "bytes": st.get("bytes", 0)})
                 return
             from eventgpt_tpu.serve import QueueFullError
 
@@ -830,6 +849,9 @@ def build_server(args) -> tuple:
         first_chunk=getattr(args, "first_chunk", 0),
         max_queue=getattr(args, "max_queue", 0),
         pipeline=not getattr(args, "no_pipeline", False),
+        prefix_cache=not getattr(args, "no_prefix_cache", False),
+        prefix_cache_bytes=int(
+            getattr(args, "prefix_cache_mb", 512.0) * 1024 * 1024),
     )
     if args.warmup:
         t0 = time.perf_counter()
@@ -920,6 +942,15 @@ def main(argv=None):
                    help="event .npy backing the <event> block inside "
                         "--prefix_prompt (prefix-through-event-block "
                         "sessions; suffixes then skip CLIP encode)")
+    p.add_argument("--prefix_cache_mb", type=float, default=512.0,
+                   help="HBM byte budget for the prefix-KV cache (LRU "
+                        "eviction above it; 0 = unbounded). The cache "
+                        "populates itself on admission prefill and via "
+                        "POST /prefix; GET /prefix_cache shows it")
+    p.add_argument("--no_prefix_cache", action="store_true",
+                   help="disable the prefix-KV cache entirely (every "
+                        "admission full-prefills; the A/B escape hatch — "
+                        "chains are byte-identical either way)")
     # -- request-lifecycle hardening (ISSUE 1) --
     p.add_argument("--max_queue", type=int, default=256,
                    help="admission-queue bound: submits beyond this get "

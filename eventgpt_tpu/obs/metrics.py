@@ -403,6 +403,34 @@ SERVE_SCHED_FAULTS = REGISTRY.counter(
 SERVE_SCHED_RESTARTS = REGISTRY.counter(
     "egpt_serve_scheduler_restarts_total",
     "Scheduler-thread restarts after a fault")
+# -- prefix-KV cache + batched admission (ISSUE 4, eventgpt_tpu/serve.py) --
+SERVE_PREFIX_HITS = REGISTRY.counter(
+    "egpt_serve_prefix_cache_hits_total",
+    "Admissions served from a cached prefix-KV entry (suffix-only prefill)")
+SERVE_PREFIX_MISSES = REGISTRY.counter(
+    "egpt_serve_prefix_cache_misses_total",
+    "Admissions that found no usable prefix entry (full prefill)")
+SERVE_PREFIX_EVICTIONS = REGISTRY.counter(
+    "egpt_serve_prefix_cache_evictions_total",
+    "Prefix entries LRU-evicted under the HBM byte budget")
+SERVE_PREFIX_INSERTIONS = REGISTRY.counter(
+    "egpt_serve_prefix_cache_insertions_total",
+    "Prefix entries inserted (set_prefix or insert-on-prefill)")
+SERVE_PREFIX_BYTES = REGISTRY.gauge(
+    "egpt_serve_prefix_cache_bytes",
+    "HBM bytes held by cached prefix-KV entries")
+SERVE_PREFIX_ENTRIES = REGISTRY.gauge(
+    "egpt_serve_prefix_cache_entries",
+    "Live prefix-KV cache entries")
+SERVE_ADMISSION_WAVE = REGISTRY.histogram(
+    "egpt_serve_admission_wave_rows",
+    "Full-prefill admissions batched into one prefill dispatch (wave size)",
+    ROWS_BUCKETS)
+SERVE_PREFILL_DISPATCHES = REGISTRY.counter(
+    "egpt_serve_prefill_dispatches_total",
+    "Admission prefill dispatches by kind: full (batch-1), wave (one per "
+    "BATCH of admissions), chunk (per chunked-prefill advance), suffix "
+    "(prefix-cache hit)")
 
 # -- fault injection (eventgpt_tpu/faults.py) --
 FAULT_TRIPS = REGISTRY.counter(

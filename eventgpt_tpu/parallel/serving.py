@@ -176,6 +176,21 @@ def place_carry(mesh: Mesh, batch: int, frozen, n_rem, base_pos=None):
     return put(frozen), put(n_rem), put(base_pos)
 
 
+def prefix_block_sharding(mesh: Mesh, cfg) -> NamedSharding:
+    """Placement of one prefix-KV cache ENTRY block (L, 1, S, KV, hd):
+    KV heads over ``model`` exactly like the resident cache (so the
+    entry copy at admission — ``serve._prefix_prefill`` reading it, and
+    ``serve._slice_prefix_block`` producing it on insert-on-prefill — is
+    a local dynamic-slice/update per shard, no resharding), everything
+    else replicated: the batch dim is 1, so the (data, fsdp) batch axes
+    drop out. The int8-KV scale plane shares the spec (its trailing dim
+    is 1; the head axis still divides)."""
+    model_n = mesh.shape.get("model", 1)
+    head_ax = ("model"
+               if model_n > 1 and cfg.num_kv_heads % model_n == 0 else None)
+    return NamedSharding(mesh, P(None, None, None, head_ax, None))
+
+
 def shard_kv_cache(cache: Any, cfg, mesh: Mesh) -> Any:
     """Place a fresh KV cache: (L, B, S, KV, hd) with batch over the serving
     batch axes and KV heads over ``model`` (skipped if it does not divide

@@ -30,6 +30,19 @@ TPU-shaped design (everything jit-visible is static-shape):
     buffer edge; XLA *drops*, not clamps, out-of-bounds scatter updates,
     so the slack is the invariant that matters) — are masked out of every
     attention read, and are overwritten when the row is re-admitted.
+  * PREFIX-KV CACHE (ISSUE 4): a token-id trie of prompt-head KV blocks
+    (``PrefixCache``) replaces the old single ``set_prefix`` slot —
+    populated by the operator AND automatically on admission prefill
+    (system-prompt / event-block heads), matched longest-prefix at
+    admission, refcount-pinned while rows decode from an entry, LRU-
+    evicted under an HBM byte budget (``prefix_cache_bytes``). Repeated
+    heads across many concurrent sessions admit by a KV copy + suffix
+    prefill instead of recompute; an event entry never serves a request
+    whose pixels are a different stream.
+  * BATCHED ADMISSION PREFILL: all full-prefill admissions ready at one
+    dispatch boundary run as ONE padded batched prefill (``_admit_wave``
+    — N x ~100 ms dispatch tax -> ~100 ms per wave), scattered into the
+    shared cache in one more dispatch.
   * PIPELINED scheduling (default): the between-segment control state
     (frozen mask, per-row budgets, gather base) is ALSO device-resident,
     updated in-graph by the segment kernels, so segment N+1 dispatches
@@ -99,6 +112,220 @@ def _pixels_key(pixel_values) -> bytes:
 
     arr = np.ascontiguousarray(np.asarray(pixel_values, np.float32))
     return str(arr.shape).encode() + hashlib.sha1(arr.tobytes()).digest()
+
+
+@dataclass
+class _PrefixEntry:
+    """One cached prompt-head KV block (ISSUE 4 tentpole). ``ids`` is the
+    token path (includes the event sentinel for through-event entries);
+    ``pixels_key`` pins an event entry to ITS stream — the wrong-stream
+    guard lives in the lookup, not at the call site. ``kv`` holds the
+    bucket-length (L, 1, bucket, KV, hd) K/V blocks (quant-aware), never
+    donated to any jit, so eviction/replacement can only ever drop the
+    last Python reference AFTER every in-flight copy completed."""
+    ids: tuple
+    pixels_key: Optional[bytes]
+    has_event: bool
+    kv: Dict[str, Any]
+    length: int          # real cache positions the entry covers
+    bucket: int          # stored block length (serving bucket grain)
+    nbytes: int
+    pins: int = 0        # rows currently decoding that admitted from this
+    tick: int = 0        # LRU clock at last insert/hit
+    hits: int = 0
+
+
+class PrefixCache:
+    """Token-id trie of prompt-head KV blocks with LRU eviction — the
+    multi-entry replacement for the single ``set_prefix`` slot (the
+    RadixAttention idea at this server's SEQ_BUCKET granularity: entries
+    are stored at the prompt bucket grain and keyed on ``(ids,
+    pixels_key)``). Populated by ``set_prefix`` (operator insert, the old
+    API) AND automatically on admission prefill (the system-prompt and
+    event-block heads of every fully-prefilled prompt), so repeated heads
+    across many concurrent sessions become cache hits without operator
+    action.
+
+    Rules:
+      * longest-prefix match wins (``lookup``); an event entry never
+        serves a request whose own pixels are a different stream;
+      * ``budget`` bytes of HBM (0 = unbounded): inserts evict the
+        least-recently-used UNPINNED entries until the new total fits;
+      * a pinned entry (``pins`` > 0: some row admitted from it is still
+        decoding) is never evicted — the refcount drains at row finish,
+        so replacement under pressure cannot yank a hot session's head
+        (and the detached-object rule in ``insert`` makes replacing a
+        pinned key safe: pins drain on the detached entry, whose KV the
+        in-flight rows' own references keep alive).
+
+    Mutations are host-side dict ops under ``_lock`` (the scheduler
+    thread inserts/looks up; HTTP handler threads read ``stats()``).
+    Device arrays are only ever referenced, never mutated in place.
+    """
+
+    def __init__(self, budget_bytes: int = 0):
+        import threading
+
+        self.budget = int(budget_bytes)
+        self._root: Dict[str, Any] = {"c": {}, "e": {}}
+        self._lock = threading.Lock()
+        self.bytes = 0
+        self.n_entries = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.insertions = 0
+        self._tick = 0
+
+    def _iter_nodes(self):
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            yield node
+            stack.extend(node["c"].values())
+
+    def entries(self) -> List[_PrefixEntry]:
+        with self._lock:
+            return [e for node in self._iter_nodes()
+                    for e in node["e"].values()]
+
+    def get(self, ids, pixels_key) -> Optional[_PrefixEntry]:
+        """Exact-key entry, or None (the insert-on-prefill dedupe)."""
+        with self._lock:
+            node = self._root
+            for tok in ids:
+                node = node["c"].get(tok)
+                if node is None:
+                    return None
+            return node["e"].get(pixels_key)
+
+    def lookup(self, ids, pixels_key) -> Optional[_PrefixEntry]:
+        """Longest-prefix match: the deepest entry whose token path is a
+        PROPER prefix of ``ids`` and whose stream identity is compatible
+        with the request — a text entry needs the event sentinel in the
+        remaining suffix, an event entry needs it consumed AND the
+        request's own pixels to BE its stream (``pixels_key`` None =
+        suffix-only session traffic, which inherits the entry's stream by
+        construction). Among entries at one node the most recently used
+        matching one wins. Hit/miss counting is the caller's (the
+        admission path counts after its fit check)."""
+        try:
+            from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
+            sent = list(ids).index(EVENT_TOKEN_INDEX)
+        except ValueError:
+            sent = -1
+        best = None
+        with self._lock:
+            node = self._root
+            for d, tok in enumerate(ids):
+                node = node["c"].get(tok)
+                if node is None:
+                    break
+                if d + 1 >= len(ids):
+                    break  # entry must be a PROPER prefix
+                cand = None
+                for e in node["e"].values():
+                    if e.has_event:
+                        if sent < 0 or sent > d:
+                            continue  # sentinel must be inside the entry
+                        if (pixels_key is not None
+                                and e.pixels_key != pixels_key):
+                            continue  # wrong stream: never serve this KV
+                    elif sent <= d:
+                        continue  # text entry: sentinel must be in suffix
+                    if cand is None or e.tick > cand.tick:
+                        cand = e
+                if cand is not None:
+                    best = cand  # deeper nodes visited later: longest wins
+        return best
+
+    def count_hit(self, entry: _PrefixEntry) -> None:
+        with self._lock:
+            self._tick += 1
+            entry.tick = self._tick
+            entry.hits += 1
+            self.hits += 1
+        obs_metrics.SERVE_PREFIX_HITS.inc()
+
+    def count_miss(self) -> None:
+        with self._lock:
+            self.misses += 1
+        obs_metrics.SERVE_PREFIX_MISSES.inc()
+
+    def insert(self, entry: _PrefixEntry) -> bool:
+        """Insert (or replace) the entry at its ``(ids, pixels_key)`` key,
+        then evict LRU unpinned entries until the budget holds. False =
+        refused (the entry alone exceeds the budget)."""
+        if self.budget and entry.nbytes > self.budget:
+            return False
+        with self._lock:
+            node = self._root
+            for tok in entry.ids:
+                node = node["c"].setdefault(tok, {"c": {}, "e": {}})
+            old = node["e"].pop(entry.pixels_key, None)
+            if old is not None:
+                # Replacement detaches the old entry object; any pins on
+                # it drain harmlessly there, and its KV stays alive via
+                # the in-flight rows' references until they finish.
+                self.bytes -= old.nbytes
+                self.n_entries -= 1
+            self._tick += 1
+            entry.tick = self._tick
+            node["e"][entry.pixels_key] = entry
+            self.bytes += entry.nbytes
+            self.n_entries += 1
+            self.insertions += 1
+            self._evict_locked()
+        self._export_gauges()
+        obs_metrics.SERVE_PREFIX_INSERTIONS.inc()
+        return True
+
+    def _evict_locked(self) -> None:
+        if not self.budget:
+            return
+        while self.bytes > self.budget:
+            victim_node, victim_key, victim = None, None, None
+            for node in self._iter_nodes():
+                for key, e in node["e"].items():
+                    if e.pins > 0:
+                        continue  # refcount pin: in-flight rows admit from it
+                    if victim is None or e.tick < victim.tick:
+                        victim_node, victim_key, victim = node, key, e
+            if victim is None:
+                # Everything left is pinned: stay over budget until the
+                # pins drain (the next insert retries the sweep).
+                return
+            del victim_node["e"][victim_key]
+            self.bytes -= victim.nbytes
+            self.n_entries -= 1
+            self.evictions += 1
+            obs_metrics.SERVE_PREFIX_EVICTIONS.inc()
+
+    def _export_gauges(self) -> None:
+        obs_metrics.SERVE_PREFIX_BYTES.set(self.bytes)
+        obs_metrics.SERVE_PREFIX_ENTRIES.set(self.n_entries)
+
+    def stats(self) -> Dict[str, Any]:
+        """Snapshot for ``GET /prefix_cache`` (lock-held, host-only)."""
+        with self._lock:
+            entries = [
+                {"ids_len": len(e.ids), "has_event": e.has_event,
+                 "length": e.length, "bucket": e.bucket,
+                 "nbytes": e.nbytes, "pins": e.pins, "hits": e.hits}
+                for node in self._iter_nodes() for e in node["e"].values()
+            ]
+            return {
+                "entries": sorted(entries, key=lambda d: -d["hits"]),
+                "n_entries": self.n_entries,
+                "bytes": self.bytes,
+                "budget_bytes": self.budget,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "insertions": self.insertions,
+                "hit_ratio": (self.hits / (self.hits + self.misses)
+                              if (self.hits + self.misses) else 0.0),
+            }
 
 
 def _decode_segment(
@@ -327,6 +554,59 @@ _admit_row_jit = functools.partial(
 )(_admit_row)
 
 
+def _admit_wave(cache, logits_buf, rows, wave_k, wave_v, wave_len,
+                wave_logits):
+    """Scatter one BATCHED admission prefill into the shared cache: every
+    wave member's row lands in ONE dispatch instead of N ``_admit_row``
+    calls. ``rows`` (Nb,) carries the destination row per wave slot;
+    slots padded to the power-of-two wave size (and NaN-quarantined
+    members) carry ``row == max_batch``, which is out of bounds — XLA
+    DROPS out-of-bounds scatter updates (the same rule the frozen-row
+    slack reservation relies on), so pad slots write nothing."""
+    s1 = (wave_k["q"] if isinstance(wave_k, dict) else wave_k).shape[2]
+
+    def ins(buf, wbuf):
+        if isinstance(buf, dict):
+            return {"q": ins(buf["q"], wbuf["q"]),
+                    "s": ins(buf["s"], wbuf["s"])}
+        return buf.at[:, rows, :s1].set(wbuf.astype(buf.dtype))
+
+    new_cache = {
+        "k": ins(cache["k"], wave_k),
+        "v": ins(cache["v"], wave_v),
+        "length": cache["length"].at[rows].set(
+            wave_len.astype(cache["length"].dtype)),
+    }
+    return new_cache, logits_buf.at[rows].set(wave_logits)
+
+
+_admit_wave_jit = functools.partial(
+    jax.jit, donate_argnames=("cache", "logits_buf")
+)(_admit_wave)
+
+
+def _slice_prefix_block(k, v, row, bucket: int):
+    """Copy cache positions [0, bucket) of batch row ``row`` out of a
+    prefilled row/wave cache — the insert-on-prefill entry copy (one
+    small device-to-device slice per NEW head; repeat heads dedupe before
+    ever reaching here). The inputs are not donated: the source cache is
+    still owed to the row admission scatter."""
+
+    def sl(buf):
+        if isinstance(buf, dict):
+            return {"q": sl(buf["q"]), "s": sl(buf["s"])}
+        sizes = (buf.shape[0], 1, bucket) + buf.shape[3:]
+        start = (jnp.int32(0), row, jnp.int32(0)) + (jnp.int32(0),) * (buf.ndim - 3)
+        return lax.dynamic_slice(buf, start, sizes)
+
+    return sl(k), sl(v)
+
+
+_slice_prefix_jit = functools.partial(
+    jax.jit, static_argnames=("bucket",)
+)(_slice_prefix_block)
+
+
 def _chunk_prefill(params, cfg: EventChatConfig, embeds, cache,
                    start, new_len, last_idx, chunk: int):
     """One chunked-admission advance: feed prompt positions
@@ -378,9 +658,15 @@ def _prefix_prefill(params, cfg: EventChatConfig, pk, pv, plen,
     (``/root/reference/inference.py:52-63``); this is the beyond-parity
     axis for shared-prompt-head traffic.
 
+    BATCHED since ISSUE 4: the same body serves the suffix-admission
+    WAVE — ``pk``/``pv`` carry N stacked entry blocks (mixed entries are
+    fine: each row copies ITS block; rows are independent in attention),
+    ``plen``/``new_len``/``last_idx`` are per-row. The batch-1 call sites
+    pass N = 1 and a scalar ``last_idx`` unchanged.
+
     Trailing suffix-pad positions write garbage K/V above ``new_len`` —
     masked from every future read, same as ``_chunk_prefill``'s pad rule.
-    Returns (last_logits (1, V), last_hidden (1, D), advanced cache).
+    Returns (last_logits (N, V), last_hidden (N, D), advanced cache).
     """
 
     def copy(buf, src):
@@ -400,10 +686,10 @@ def _prefix_prefill(params, cfg: EventChatConfig, pk, pv, plen,
         params["llama"], cfg.llama, suffix_embeds, cache, return_hidden=True
     )
     last = jnp.take_along_axis(
-        logits, jnp.reshape(last_idx, (1, 1, 1)), axis=1
+        logits, jnp.reshape(last_idx, (-1, 1, 1)), axis=1
     )[:, 0]
     last_hidden = jnp.take_along_axis(
-        hidden, jnp.reshape(last_idx, (1, 1, 1)), axis=1
+        hidden, jnp.reshape(last_idx, (-1, 1, 1)), axis=1
     )[:, 0]
     return last, last_hidden, {**cache, "length": new_len}
 
@@ -521,6 +807,32 @@ def _get_sharded_prefix_prefill(cfg, flat_row_sh, row_treedef, last_sh,
     )
 
 
+@functools.lru_cache(maxsize=16)
+def _get_sharded_admit_wave(flat_cache_sh, cache_treedef, logits_sh):
+    """Batched-admission scatter with the shared cache/logits placement
+    pinned (same aliasing reasoning as ``_get_sharded_admit``: an
+    unpinned output would silently break the donated-cache aliasing)."""
+    cache_sh = jax.tree_util.tree_unflatten(cache_treedef, list(flat_cache_sh))
+    return jax.jit(
+        _admit_wave,
+        donate_argnums=(0, 1),
+        out_shardings=(cache_sh, logits_sh),
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _get_sharded_slice_prefix(bucket, block_sh, quant):
+    """Entry copy (insert-on-prefill) under a mesh, with the output block
+    pinned to the prefix-entry placement (``parallel/serving.
+    prefix_block_sharding``: KV heads over ``model``, everything else
+    replicated — batch is 1, so the batch axes drop out)."""
+    out_sh = ({"q": block_sh, "s": block_sh} if quant else block_sh)
+    return jax.jit(
+        lambda k, v, row: _slice_prefix_block(k, v, row, bucket),
+        out_shardings=(out_sh, out_sh),
+    )
+
+
 @dataclass
 class _PendingAdmission:
     """A chunked admission in flight: the row is reserved (frozen), the
@@ -560,6 +872,10 @@ class _Request:
     # while queued and between decode segments: an expired row is frozen
     # and finished with STATUS_DEADLINE instead of burning its budget.
     deadline: Optional[float] = None
+    # Prefix-cache entry this row admitted from (refcount pin: the entry
+    # cannot be LRU-evicted until the row finishes; _record_finish drains
+    # it). None for full-prefill admissions.
+    prefix_entry: Optional["_PrefixEntry"] = None
 
 
 class ContinuousBatcher:
@@ -600,6 +916,9 @@ class ContinuousBatcher:
         max_queue: int = 0,
         nan_check: bool = True,
         pipeline: bool = True,
+        prefix_cache: bool = True,
+        prefix_cache_bytes: int = 0,
+        prefix_insert: bool = True,
     ):
         if prefill_chunk and (2 * SEQ_BUCKET) % prefill_chunk:
             # A chunk that does not divide the bucket grain would force
@@ -726,7 +1045,23 @@ class ContinuousBatcher:
         self._next_rid = 0
         self.prefill_chunk = int(prefill_chunk)
         self._pending: Optional[_PendingAdmission] = None
-        self._prefix = None  # shared-prefix KV seed (set_prefix)
+        # Prefix-KV cache (ISSUE 4 tentpole): the multi-entry trie that
+        # replaced the single set_prefix slot. ``prefix_cache=False`` is
+        # the A/B escape hatch (every admission full-prefills);
+        # ``prefix_insert=False`` keeps lookups but disables the
+        # automatic insert-on-prefill population (operator-set entries
+        # only — the r5 single-slot behavior, for benchmarking).
+        self._prefix_cache = (
+            PrefixCache(int(prefix_cache_bytes)) if prefix_cache else None
+        )
+        self.prefix_insert = bool(prefix_insert)
+        # Per-position K+V bytes of one resident cache row — the prefix
+        # cache's accounting unit (entry nbytes = bucket * this; derived
+        # from the live buffers so int8-KV halves it automatically).
+        _kv_leaves = jax.tree_util.tree_leaves(
+            {"k": self.cache["k"], "v": self.cache["v"]})
+        self._kv_pos_bytes = max(
+            1, sum(x.nbytes for x in _kv_leaves) // (max_batch * self.max_len))
         # Pipelined scheduling (the default): between-segment control state
         # (frozen / n_rem / base_pos) ALSO lives on device, updated
         # in-graph by the segment kernels, so segment N+1 is dispatched
@@ -929,27 +1264,41 @@ class ContinuousBatcher:
             jax.block_until_ready(rec["n_new"])
             n += 1
         self._dev_carry = None
-        if self._prefix is not None:
-            # Prefix-admission executable (_prefix_prefill at the smallest
-            # suffix bucket — query tails; a longer real suffix compiles
-            # its own). The dummy row cache is discarded, nothing touches
-            # the resident state.
+        if self._prefix_cache is not None and self._prefix_cache.n_entries:
+            # Prefix-admission (suffix) executables, one per distinct
+            # entry shape (_prefix_prefill at the smallest suffix bucket
+            # — query tails; a longer real suffix compiles its own). The
+            # dummy row caches are discarded, nothing touches the
+            # resident state, and record=False keeps the warmup
+            # dispatches out of the hit/dispatch telemetry and the armed
+            # fault plans (the serve.prefix_copy site counts only real
+            # admissions).
             from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
 
-            dummy = [0] if self._prefix["has_event"] else [EVENT_TOKEN_INDEX]
             dummy_pv = np.zeros(
                 (self.cfg.num_event_frames, 3, self.cfg.vision.image_size,
                  self.cfg.vision.image_size), np.float32,
             )
-            if self._prefix_admit(dummy_pv, dummy) is not None:
-                n += 1
+            warmed_shapes = set()
+            for entry in self._prefix_cache.entries():
+                shape_key = (entry.bucket, entry.has_event, entry.length)
+                if shape_key in warmed_shapes:
+                    continue
+                dummy = [0] if entry.has_event else [EVENT_TOKEN_INDEX]
+                if self._prefix_admit(entry, dummy_pv, dummy,
+                                      record=False) is not None:
+                    warmed_shapes.add(shape_key)
+                    n += 1
         return n
 
     def set_prefix(self, input_ids: Sequence[int],
                    pixel_values=None) -> int:
-        """Prefill a shared prompt prefix ONCE; admissions whose prompts
-        start with these exact ids skip its encode + prefill and run only
-        their suffix (``_prefix_prefill``). Two regimes:
+        """Prefill a shared prompt prefix ONCE and INSERT it into the
+        prefix-KV cache (since ISSUE 4 this is one entry among many — the
+        cache also populates itself on admission prefill; POST /prefix is
+        an insert, not a replacement). Admissions whose prompts start
+        with these exact ids skip its encode + prefill and run only their
+        suffix (``_prefix_prefill``). Two regimes:
 
           * text-only prefix (the system-prompt head): suffixes carry the
             event sentinel and still pay CLIP encode;
@@ -964,6 +1313,11 @@ class ContinuousBatcher:
         from eventgpt_tpu.models.eventchat import _pad_batch, _prefill_jit, \
             _prefill_sharded, splice_embeddings
 
+        if self._prefix_cache is None:
+            raise RuntimeError(
+                "prefix cache is disabled (prefix_cache=False); set_prefix "
+                "has nowhere to insert"
+            )
         ids = list(input_ids)
         n_ev = sum(1 for t in ids if t == EVENT_TOKEN_INDEX)
         if n_ev > 1:
@@ -1010,53 +1364,61 @@ class ContinuousBatcher:
             _, row_cache = _prefill_jit(
                 self.params, self.cfg, padded, mask, row_cache, True
             )
-        self._prefix = {"ids": ids, "len": p_len, "cache": row_cache,
-                        "bucket": s1p, "has_event": n_ev == 1,
-                        # Identity of the prefix's event stream: admissions
-                        # whose pixels differ must NOT reuse this KV.
-                        "pixels_key": (_pixels_key(pixel_values)
-                                       if n_ev == 1 else None)}
+        entry = _PrefixEntry(
+            ids=tuple(ids),
+            # Identity of the prefix's event stream: admissions whose
+            # pixels differ must NOT reuse this KV.
+            pixels_key=(_pixels_key(pixel_values) if n_ev == 1 else None),
+            has_event=n_ev == 1,
+            kv={"k": row_cache["k"], "v": row_cache["v"]},
+            length=p_len, bucket=s1p,
+            nbytes=s1p * self._kv_pos_bytes,
+        )
+        if not self._prefix_cache.insert(entry):
+            raise ValueError(
+                f"prefix entry ({entry.nbytes} bytes at bucket {s1p}) "
+                f"exceeds the prefix-cache budget "
+                f"{self._prefix_cache.budget} (raise --prefix_cache_mb)"
+            )
         return p_len
 
+    def _prefix_lookup(self, req) -> Optional[tuple]:
+        """Longest-prefix match of ``req``'s prompt against the cache:
+        (entry, suffix_ids) of the deepest compatible entry, or None
+        (full-prefill fallback). The wrong-stream guard (ADVICE r5
+        medium) lives in ``PrefixCache.lookup``: an event entry whose
+        pixels differ from the request's own stream is never returned —
+        though the request may still hit a shallower TEXT entry, whose
+        KV carries no event content."""
+        pc = self._prefix_cache
+        if pc is None or pc.n_entries == 0:
+            return None
+        pk = (None if req.pixel_values is None
+              else _pixels_key(req.pixel_values))
+        ids = list(req.input_ids)
+        entry = pc.lookup(ids, pk)
+        if entry is None:
+            return None
+        return entry, ids[len(entry.ids):]
+
     def _prefix_suffix_ids(self, req) -> Optional[List[int]]:
-        """Suffix of ``req``'s prompt after the shared prefix, or None when
-        the request does not match (full-prefill fallback)."""
+        """Suffix of ``req``'s prompt after the longest matching cached
+        prefix, or None when nothing matches (full-prefill fallback)."""
+        hit = self._prefix_lookup(req)
+        return None if hit is None else hit[1]
+
+    def _prefix_fit(self, entry: _PrefixEntry,
+                    suffix_ids) -> Optional[tuple]:
+        """Bucket arithmetic of a suffix admission against ``entry``:
+        (suf_len, prompt_len, chunk, s1), or None when the row bucket
+        can't host entry block + padded suffix (full-prefill fallback).
+        Runs BEFORE any encode, so a falling-back request pays its CLIP
+        once, on the full path — and before wave grouping, which keys on
+        (chunk, s1)."""
         from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
 
-        pre = self._prefix
-        if pre is None:
-            return None
-        pids = pre["ids"]
-        ids = req.input_ids
-        if len(ids) <= len(pids) or ids[: len(pids)] != pids:
-            return None
-        suffix = ids[len(pids):]
-        has_ev = any(t == EVENT_TOKEN_INDEX for t in suffix)
-        # The sentinel must live on exactly one side of the split.
-        if has_ev == pre["has_event"]:
-            return None
-        if pre["has_event"] and req.pixel_values is not None:
-            # Event-block prefix guard (ADVICE r5 medium): the request's
-            # own pixels must BE the prefix's stream, or the cached KV
-            # would silently answer about the wrong stream. Mismatch ->
-            # full prefill of the request's own prompt + pixels.
-            if _pixels_key(req.pixel_values) != pre["pixels_key"]:
-                return None
-        return suffix
-
-    def _prefix_admit(self, pixel_values, suffix_ids):
-        """Suffix-only admission against the shared prefix KV. Returns
-        (row_cache, row_logits, row_hidden, prompt_len), or None when the
-        bucket arithmetic can't host prefix + padded suffix (fall back).
-        The fit check runs BEFORE the CLIP encode, so a falling-back
-        request pays its encode once, on the full-prefill path."""
-        from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
-        from eventgpt_tpu.data.tokenizer import split_at_event
-        from eventgpt_tpu.models.eventchat import splice_embeddings
-
-        pre = self._prefix
-        p_len = pre["len"]
-        if pre["has_event"]:
+        p_len = entry.length
+        if entry.has_event:
             suf_len = len(suffix_ids)
         else:
             suf_len = (
@@ -1070,12 +1432,21 @@ class ContinuousBatcher:
             ((max(prompt_len, p_len + chunk) + grain - 1) // grain) * grain,
             self.max_len,
         )
-        if p_len + chunk > s1 or s1 < pre["bucket"]:
+        if p_len + chunk > s1 or s1 < entry.bucket:
             # Prompt too close to max_len for the padded suffix, or the
-            # row bucket can't host the prefix's stored block — fall back
-            # to the full prefill path.
+            # row bucket can't host the entry's stored block.
             return None
-        if pre["has_event"]:
+        return suf_len, prompt_len, chunk, s1
+
+    def _suffix_embed(self, entry: _PrefixEntry, pixel_values, suffix_ids,
+                      chunk: int, suf_len: int):
+        """(1, chunk, D) padded suffix embeddings for one admission: a
+        through-event entry's suffix is plain text (no CLIP); a text
+        entry's suffix carries the sentinel and pays its own encode."""
+        from eventgpt_tpu.data.tokenizer import split_at_event
+        from eventgpt_tpu.models.eventchat import splice_embeddings
+
+        if entry.has_event:
             emb = llama_mod.embed_tokens(
                 self.params["llama"], jnp.asarray([suffix_ids], jnp.int32)
             )
@@ -1088,11 +1459,45 @@ class ContinuousBatcher:
                 self.params, self.cfg, split_at_event(suffix_ids), ev[0]
             )[None]
         assert emb.shape[1] == suf_len, (emb.shape, suf_len)
-        emb = jnp.pad(emb, ((0, 0), (0, chunk - suf_len), (0, 0)))
+        return jnp.pad(emb, ((0, 0), (0, chunk - suf_len), (0, 0)))
+
+    def _suffix_wave_sh(self, nb: int):
+        """(last_sh, hidden_sh) pins for a batch-``nb`` suffix prefill
+        under the serving mesh (batch over the serving batch axes, vocab
+        axis reused from the resident logits placement)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        baxes = self._serving.serving_batch_axes(self.mesh, nb)
+        bspec = baxes if baxes else None
+        vocab_ax = self._logits_sh.spec[1]
+        return (NamedSharding(self.mesh, P(bspec, vocab_ax)),
+                NamedSharding(self.mesh, P(bspec, None)))
+
+    def _prefix_admit(self, entry: _PrefixEntry, pixel_values, suffix_ids,
+                      record: bool = True):
+        """Suffix-only admission against one cached prefix-KV entry.
+        Returns (row_cache, row_logits, row_hidden, prompt_len), or None
+        when ``_prefix_fit`` rejects (fall back to full prefill).
+        ``record=False`` (warmup) skips the ``serve.prefix_copy`` fault
+        probe and the dispatch/trace telemetry."""
+        fit = self._prefix_fit(entry, suffix_ids)
+        if fit is None:
+            return None
+        suf_len, prompt_len, chunk, s1 = fit
+        emb = self._suffix_embed(entry, pixel_values, suffix_ids, chunk,
+                                 suf_len)
+        if record:
+            # The copy boundary is its own fault site (ISSUE 4 satellite):
+            # a fault HERE lands with a row reserved and an entry about to
+            # be read — exactly the window the engine's sweep and the
+            # entry's never-donated KV must survive.
+            faults.maybe_fail("serve.prefix_copy")
+            faults.maybe_delay("serve.prefix_copy")
+        t0 = time.perf_counter()
         row_cache = self._new_row_cache(s1)
         new_len = jnp.asarray([prompt_len], jnp.int32)
         last_idx = jnp.asarray(suf_len - 1, jnp.int32)
-        plen_arr = jnp.asarray([p_len], jnp.int32)
+        plen_arr = jnp.asarray([entry.length], jnp.int32)
         if self.mesh is not None:
             emb = self._serving.shard_batch_array(emb, self.mesh)
             row_sh = jax.tree_util.tree_map(lambda x: x.sharding, row_cache)
@@ -1105,15 +1510,106 @@ class ContinuousBatcher:
                 hidden_sh,
             )
             last, hidden, row_cache = fn(
-                self.params, pre["cache"]["k"], pre["cache"]["v"], plen_arr,
+                self.params, entry.kv["k"], entry.kv["v"], plen_arr,
                 row_cache, emb, new_len, last_idx,
             )
         else:
             last, hidden, row_cache = _prefix_prefill_jit(
-                self.params, self.cfg, pre["cache"]["k"], pre["cache"]["v"],
+                self.params, self.cfg, entry.kv["k"], entry.kv["v"],
                 plen_arr, row_cache, emb, new_len, last_idx,
             )
+        if record:
+            obs_metrics.SERVE_PREFILL_DISPATCHES.inc(kind="suffix")
+            tr = obs_trace.active()
+            if tr is not None:
+                tr.complete("prefix_copy", t0, time.perf_counter(),
+                            cat="sched", args={"plen": entry.length,
+                                               "suffix": suf_len})
         return row_cache, last, hidden, prompt_len
+
+    def _admit_suffix_wave(self, members: List[tuple]) -> None:
+        """BATCHED suffix admission: N prefix-cache hits sharing the
+        padded (chunk, s1) shape run ONE stacked entry-copy +
+        ``decode_kstep`` dispatch, scattered into the shared cache with
+        the same one-dispatch wave insert as ``_admit_wave``. Entries may
+        DIFFER per member (each row copies its own stacked block) — this
+        is what makes round-robin session traffic, which hits S distinct
+        heads at every boundary, N→1 instead of N sequential suffix
+        dispatches. Members: (req, row, entry, suffix_ids, fit) tuples."""
+        n = len(members)
+        nb = 1 << (n - 1).bit_length()
+        _, _, chunk, s1 = members[0][4]
+        for req, row, entry, suffix_ids, fit in members:
+            self._prefix_cache.count_hit(entry)
+        faults.maybe_fail("serve.prefix_copy")
+        faults.maybe_delay("serve.prefix_copy")
+        t0 = time.perf_counter()
+        s_pre = max(m[2].bucket for m in members)
+
+        def pad_block(buf, width):
+            if isinstance(buf, dict):
+                return {"q": pad_block(buf["q"], width),
+                        "s": pad_block(buf["s"], width)}
+            return jnp.pad(buf, ((0, 0), (0, 0), (0, width - buf.shape[2]))
+                           + ((0, 0),) * (buf.ndim - 3))
+
+        def cat_blocks(blocks):
+            if isinstance(blocks[0], dict):
+                return {"q": jnp.concatenate([b["q"] for b in blocks], 1),
+                        "s": jnp.concatenate([b["s"] for b in blocks], 1)}
+            return jnp.concatenate(blocks, axis=1)
+
+        pks = [pad_block(m[2].kv["k"], s_pre) for m in members]
+        pvs = [pad_block(m[2].kv["v"], s_pre) for m in members]
+        if nb > n:
+            # Pad slots reuse the first member's block (their rows scatter
+            # out of bounds and their length is pinned to 1 below).
+            pks += [pks[0]] * (nb - n)
+            pvs += [pvs[0]] * (nb - n)
+        wave_pk, wave_pv = cat_blocks(pks), cat_blocks(pvs)
+        embs = [self._suffix_embed(m[2], m[0].pixel_values, m[3], chunk,
+                                   m[4][0])
+                for m in members]
+        emb = jnp.concatenate(
+            embs + [jnp.zeros_like(embs[0])] * (nb - n), axis=0)
+        plen_arr = jnp.asarray(
+            [m[2].length for m in members] + [1] * (nb - n), jnp.int32)
+        new_len = jnp.asarray(
+            [m[4][1] for m in members] + [1] * (nb - n), jnp.int32)
+        last_idx = jnp.asarray(
+            [m[4][0] - 1 for m in members] + [0] * (nb - n), jnp.int32)
+        prompt_lens = [m[4][1] for m in members]
+        row_cache = llama_mod.init_kv_cache(
+            self.cfg.llama, nb, s1, dtype=self._dtype, quant=self.kv_quant)
+        if self.mesh is not None:
+            emb = self._serving.shard_batch_array(emb, self.mesh)
+            row_cache = self._serving.shard_kv_cache(
+                row_cache, self.cfg.llama, self.mesh)
+            row_sh = jax.tree_util.tree_map(lambda x: x.sharding, row_cache)
+            flat, treedef = jax.tree_util.tree_flatten(row_sh)
+            last_sh, hidden_sh = self._suffix_wave_sh(nb)
+            fn = _get_sharded_prefix_prefill(
+                self.cfg, tuple(flat), treedef, last_sh, hidden_sh,
+            )
+            last, hidden, row_cache = fn(
+                self.params, wave_pk, wave_pv, plen_arr, row_cache, emb,
+                new_len, last_idx,
+            )
+        else:
+            last, hidden, row_cache = _prefix_prefill_jit(
+                self.params, self.cfg, wave_pk, wave_pv, plen_arr,
+                row_cache, emb, new_len, last_idx,
+            )
+        obs_metrics.SERVE_PREFILL_DISPATCHES.inc(kind="suffix_wave")
+        tr = obs_trace.active()
+        if tr is not None:
+            tr.complete("prefix_copy", t0, time.perf_counter(),
+                        cat="sched", args={"wave": n})
+        self._scatter_wave(
+            [(m[0], m[1]) for m in members], row_cache, last,
+            hidden if self.draft_head is not None else None, prompt_lens,
+            entries=[m[2] for m in members],
+        )
 
     def submit(self, input_ids: Sequence[int], pixel_values,
                max_new_tokens: int = 64,
@@ -1206,6 +1702,14 @@ class ContinuousBatcher:
         self._drain()
         out, self.finished = self.finished, {}
         return out
+
+    def prefix_cache_stats(self) -> Dict[str, Any]:
+        """Prefix-KV cache snapshot (``GET /prefix_cache``): entry list,
+        byte budget/usage, hit/miss/eviction counters."""
+        if self._prefix_cache is None:
+            return {"enabled": False}
+        return {"enabled": True, "insert_on_prefill": self.prefix_insert,
+                **self._prefix_cache.stats()}
 
     def spec_tokens_per_iteration(self) -> float:
         """Realized aggregate acceptance: committed tokens per verify
@@ -1641,6 +2145,12 @@ class ContinuousBatcher:
         self._record_finish(req, status)
 
     def _record_finish(self, req: _Request, status: str) -> None:
+        if req.prefix_entry is not None:
+            # Drain the refcount pin on EVERY terminal path (EOS, budget,
+            # deadline, cancel, quarantine): the entry becomes evictable
+            # once its last in-flight row is gone.
+            req.prefix_entry.pins -= 1
+            req.prefix_entry = None
         if req.deadline is not None:
             self._n_deadlines -= 1
         ids = req.tokens
@@ -1699,7 +2209,14 @@ class ContinuousBatcher:
     def _admit(self) -> bool:
         """Returns True when this step did admission work (advanced a
         pending chunked prefill or popped the queue) — the telemetry
-        gate for the admission-stall histogram."""
+        gate for the admission-stall histogram.
+
+        Admission order per popped request: longest-prefix match against
+        the prefix-KV cache (suffix-only admission), else the chunked
+        path (when actives are decoding), else collected into this
+        step's FULL-PREFILL WAVE — every wave member runs in ONE batched
+        prefill dispatch (``_admit_wave``) instead of N sequential
+        batch-1 prefills."""
         from eventgpt_tpu.models.eventchat import _prefill_jit, _prefill_sharded
 
         faults.maybe_fail("serve.admit")
@@ -1708,6 +2225,8 @@ class ContinuousBatcher:
         if self._pending is not None:
             did_work = True
             self._advance_pending()
+        wave: List[tuple] = []  # (req, row) full-prefill admissions
+        hits: List[tuple] = []  # (req, row, entry, suffix_ids, fit)
         while (self._pending is None and self.queue
                and any(self.rows[r] is None
                        for r in range(self.max_batch))):
@@ -1722,51 +2241,97 @@ class ContinuousBatcher:
                 req.phase = "active"
             row = next(r for r in range(self.max_batch)
                        if self.rows[r] is None)
-            suffix_ids = self._prefix_suffix_ids(req)
-            if suffix_ids is not None:
-                pre_admit = self._prefix_admit(req.pixel_values, suffix_ids)
-                if pre_admit is not None:
-                    row_cache, row_logits, row_hidden, prompt_len = pre_admit
-                    self._finish_admission(
-                        req, row, prompt_len, row_cache, row_logits,
-                        row_hidden if self.draft_head is not None else None,
-                    )
+            # Reserve the row NOW (it stays frozen until activation): a
+            # fault mid-admission (serve.prefix_copy, a prefill error)
+            # must leave the request somewhere the engine's sweep can
+            # fail cleanly instead of stranding its waiter.
+            self.rows[row] = req
+            req.row = row
+            hit = None
+            if self._prefix_cache is not None:
+                t0 = time.perf_counter()
+                hit = self._prefix_lookup(req)
+                tr = obs_trace.active()
+                if tr is not None:
+                    tr.complete("prefix_lookup", t0, time.perf_counter(),
+                                cat="sched", args={"hit": hit is not None})
+            if hit is not None:
+                entry, suffix_ids = hit
+                fit = self._prefix_fit(entry, suffix_ids)
+                if fit is not None:
+                    hits.append((req, row, entry, suffix_ids, fit))
                     continue
-            padded, mask, prompt_len = self._prep_request(req)
-            row_cache = self._new_row_cache(padded.shape[1])
+            if self._prefix_cache is not None:
+                self._prefix_cache.count_miss()
             if self.prefill_chunk and not bool(self.frozen.all()):
-                # Active rows are decoding: chunked admission. Reserve the
-                # row (kept frozen) and advance ONE prefill chunk per
-                # scheduler step, so a long prompt stalls each decode
+                # Active rows are decoding: chunked admission. The row is
+                # reserved (kept frozen) and ONE prefill chunk advances
+                # per scheduler step, so a long prompt stalls each decode
                 # segment by at most one chunk instead of its full prefill.
-                self.rows[row] = req
-                req.row = row
+                padded, mask, prompt_len = self._prep_request(req)
+                row_cache = self._new_row_cache(padded.shape[1])
                 self._pending = _PendingAdmission(
                     req, row, padded, prompt_len, row_cache
                 )
                 self._advance_pending()
                 break
-            # No active rows to stall (or chunking disabled): one-shot
-            # prefill at the bucket length. Medusa mode also needs the
-            # prompt's last hidden to seed the row's first draft window.
-            want_hidden = self.draft_head is not None
-            row_hidden = None
-            if self.mesh is not None:
-                pre = _prefill_sharded(
-                    self.params, self.cfg, padded, mask, row_cache,
-                    self.mesh, return_hidden=want_hidden,
+            wave.append((req, row))
+        # Suffix admissions first, grouped into waves by padded shape:
+        # round-robin session traffic hits S DIFFERENT heads at one
+        # boundary, so the wave stacks per-member entry blocks — batching
+        # by entry alone would leave S sequential dispatches.
+        groups: Dict[tuple, List[tuple]] = {}
+        for h in hits:
+            groups.setdefault((h[4][2], h[4][3]), []).append(h)
+        for (_, _), members in sorted(groups.items()):
+            obs_metrics.SERVE_ADMISSION_WAVE.observe(len(members))
+            if len(members) == 1:
+                req, row, entry, suffix_ids, fit = members[0]
+                pre_admit = self._prefix_admit(entry, req.pixel_values,
+                                               suffix_ids)
+                if pre_admit is None:  # unreachable: fit pre-checked
+                    wave.append((req, row))
+                    continue
+                self._prefix_cache.count_hit(entry)
+                row_cache, row_logits, row_hidden, prompt_len = pre_admit
+                self._finish_admission(
+                    req, row, prompt_len, row_cache, row_logits,
+                    row_hidden if self.draft_head is not None else None,
+                    prefix_entry=entry,
                 )
             else:
-                pre = _prefill_jit(
-                    self.params, self.cfg, padded, mask, row_cache, True,
-                    return_hidden=want_hidden,
-                )
-            if want_hidden:
-                row_logits, row_hidden, row_cache = pre
-            else:
-                row_logits, row_cache = pre
-            self._finish_admission(req, row, prompt_len, row_cache,
-                                   row_logits, row_hidden)
+                self._admit_suffix_wave(members)
+        if not wave:
+            return did_work
+        obs_metrics.SERVE_ADMISSION_WAVE.observe(len(wave))
+        if len(wave) > 1:
+            self._admit_wave(wave)
+            return True
+        # Single admission: the batch-1 path (its executables are the
+        # ones warmup precompiles). Medusa mode also needs the prompt's
+        # last hidden to seed the row's first draft window.
+        req, row = wave[0]
+        padded, mask, prompt_len = self._prep_request(req)
+        row_cache = self._new_row_cache(padded.shape[1])
+        want_hidden = self.draft_head is not None
+        row_hidden = None
+        if self.mesh is not None:
+            pre = _prefill_sharded(
+                self.params, self.cfg, padded, mask, row_cache,
+                self.mesh, return_hidden=want_hidden,
+            )
+        else:
+            pre = _prefill_jit(
+                self.params, self.cfg, padded, mask, row_cache, True,
+                return_hidden=want_hidden,
+            )
+        obs_metrics.SERVE_PREFILL_DISPATCHES.inc(kind="full")
+        if want_hidden:
+            row_logits, row_hidden, row_cache = pre
+        else:
+            row_logits, row_cache = pre
+        self._finish_admission(req, row, prompt_len, row_cache,
+                               row_logits, row_hidden)
         return did_work
 
     def _prep_request(self, req: _Request):
@@ -1847,6 +2412,7 @@ class ContinuousBatcher:
                 self.params, self.cfg, p.embeds, p.row_cache,
                 start_arr, new_len, last_idx, c,
             )
+        obs_metrics.SERVE_PREFILL_DISPATCHES.inc(kind="chunk")
         p.filled = end
         p.last_logits = last
         if p.filled >= p.prompt_len:
@@ -1856,8 +2422,184 @@ class ContinuousBatcher:
             )
             self._pending = None
 
+    def _admit_wave(self, wave: List[tuple]) -> None:
+        """BATCHED admission prefill (the tentpole's second half): N
+        admissions ready at one dispatch boundary run ONE prefill at a
+        common bucket instead of N sequential batch-1 dispatches — on
+        hardware every dispatch pays the ~100 ms tunnel tax, so a wave
+        costs ~1/N of the sequential path (the r4 batch-16 leg was
+        "bounded by the 16 per-request prefills"). The CLIP encode is
+        batched the same way. Members pad to the widest member's prompt
+        bucket and to the next power-of-two wave size (log-bounded
+        executable count); pad slots scatter to row index ``max_batch``,
+        which XLA drops as out of bounds. Chains are unchanged: rows are
+        independent in attention, and the per-row kernel is the same one
+        ``generate`` already runs batched (bit-exact on the CPU f32
+        suite, tests/test_prefix_cache.py)."""
+        from eventgpt_tpu.data.tokenizer import split_at_event
+        from eventgpt_tpu.models.eventchat import (
+            _pad_batch, _prefill_jit, _prefill_sharded, splice_embeddings,
+        )
+
+        n = len(wave)
+        nb = 1 << (n - 1).bit_length()
+        pv = jnp.stack([jnp.asarray(req.pixel_values, self._dtype)
+                        for req, _ in wave])
+        if nb > n:
+            pv = jnp.concatenate(
+                [pv, jnp.zeros((nb - n,) + pv.shape[1:], self._dtype)])
+        if self.mesh is not None:
+            pv = self._serving.shard_batch_array(pv, self.mesh)
+        ev = eventchat.encode_events_batch(self.params, self.cfg, pv)
+        embeds = [splice_embeddings(self.params, self.cfg,
+                                    split_at_event(req.input_ids), ev[i])
+                  for i, (req, _) in enumerate(wave)]
+        padded, mask, lens = _pad_batch(embeds)
+        prompt_lens = [int(x) for x in lens]
+        grain = 2 * SEQ_BUCKET
+        s1 = min(((max(prompt_lens) + grain - 1) // grain) * grain,
+                 self.max_len)
+        padded = jnp.pad(
+            padded, ((0, nb - n), (0, s1 - padded.shape[1]), (0, 0)))
+        mask = jnp.pad(mask, ((0, nb - n), (0, s1 - mask.shape[1])))
+        if nb > n:
+            # Pad rows keep ONE real position: their (dropped) garbage KV
+            # stays finite instead of feeding an all-masked softmax.
+            mask = mask.at[n:, 0].set(True)
+        wave_cache = llama_mod.init_kv_cache(
+            self.cfg.llama, nb, s1, dtype=self._dtype, quant=self.kv_quant)
+        want_hidden = self.draft_head is not None
+        if self.mesh is not None:
+            padded = self._serving.shard_batch_array(padded, self.mesh)
+            mask = self._serving.shard_batch_array(mask, self.mesh)
+            wave_cache = self._serving.shard_kv_cache(
+                wave_cache, self.cfg.llama, self.mesh)
+            pre = _prefill_sharded(
+                self.params, self.cfg, padded, mask, wave_cache, self.mesh,
+                return_hidden=want_hidden,
+            )
+        else:
+            pre = _prefill_jit(
+                self.params, self.cfg, padded, mask, wave_cache, True,
+                return_hidden=want_hidden,
+            )
+        obs_metrics.SERVE_PREFILL_DISPATCHES.inc(kind="wave")
+        if want_hidden:
+            wave_logits, wave_hidden, wave_cache = pre
+        else:
+            (wave_logits, wave_cache), wave_hidden = pre, None
+        self._scatter_wave(wave, wave_cache, wave_logits, wave_hidden,
+                           prompt_lens)
+
+    def _scatter_wave(self, members: List[tuple], wave_cache, wave_logits,
+                      wave_hidden, prompt_lens: List[int],
+                      entries: Optional[List[_PrefixEntry]] = None) -> None:
+        """Common tail of both admission waves: per-member NaN
+        quarantine, insert-on-prefill of new heads, the one-dispatch
+        scatter of every surviving row into the shared cache, then row
+        activation. ``members`` are (req, row) pairs; quarantined and
+        pow2-pad slots keep row index ``max_batch`` (dropped by the
+        scatter's out-of-bounds rule)."""
+        n = len(members)
+        nb = (wave_cache["k"]["q"] if isinstance(wave_cache["k"], dict)
+              else wave_cache["k"]).shape[1]
+        rows = np.full((nb,), self.max_batch, np.int32)  # OOB = dropped
+        good = []
+        finite = None
+        if self.nan_check:
+            finite = np.isfinite(
+                np.asarray(jax.device_get(wave_logits))[:n]).all(axis=-1)
+        for i, (req, row) in enumerate(members):
+            if finite is not None and not finite[i]:
+                # Same per-request quarantine as the batch-1 path: the
+                # poisoned member never touches the shared cache (its
+                # wave slot scatters out of bounds); siblings admit.
+                self.rows[row] = None
+                self.frozen[row] = True
+                self._finish_forced(req, STATUS_NAN)
+                continue
+            self._insert_prefix_on_prefill(req, wave_cache, src_row=i)
+            rows[i] = row
+            good.append((i, req, row))
+        rows_arr = jnp.asarray(rows)
+        if self.mesh is not None:
+            rows_arr = self._serving.replicate(rows_arr, self.mesh)
+            admit = _get_sharded_admit_wave(
+                self._cache_flat_sh, self._cache_treedef, self._logits_sh
+            )
+        else:
+            admit = _admit_wave_jit
+        self.cache, self.logits = admit(
+            self.cache, self.logits, rows_arr, wave_cache["k"],
+            wave_cache["v"], wave_cache["length"], wave_logits,
+        )
+        for i, req, row in good:
+            row_hidden = (wave_hidden[i:i + 1]
+                          if wave_hidden is not None else None)
+            self._activate_row(req, row, prompt_lens[i],
+                               wave_logits[i:i + 1], row_hidden,
+                               entries[i] if entries is not None else None)
+
+    def _insert_prefix_on_prefill(self, req, row_cache,
+                                  src_row: int = 0) -> None:
+        """Insert-on-prefill (the tentpole's population rule): after any
+        admission that filled a row cache through the request's whole
+        prompt, slice its reusable heads into the prefix cache — the
+        TEXT head before the event sentinel (shared across ALL streams)
+        and the head THROUGH the event block (keyed to this request's
+        stream). The next request repeating a head admits by copy. Repeat
+        heads dedupe on the exact ``(ids, pixels_key)`` key, so steady
+        traffic pays one trie probe here, not a device copy."""
+        pc = self._prefix_cache
+        if pc is None or not self.prefix_insert:
+            return
+        from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
+
+        ids = list(req.input_ids)
+        try:
+            sent = ids.index(EVENT_TOKEN_INDEX)
+        except ValueError:
+            return
+        heads = []
+        if sent >= 1:
+            heads.append((tuple(ids[:sent]), None, False, sent))
+        if req.pixel_values is not None:
+            heads.append((tuple(ids[:sent + 1]),
+                          _pixels_key(req.pixel_values), True,
+                          sent + self.cfg.num_event_tokens))
+        grain = 2 * SEQ_BUCKET
+        for hid, pk, has_ev, hlen in heads:
+            if hlen + SEQ_BUCKET > self.max_len:
+                continue  # no room for any suffix: a match could never admit
+            if pc.get(hid, pk) is not None:
+                continue  # already cached (the hit path touches its LRU)
+            bucket = min(((hlen + grain - 1) // grain) * grain, self.max_len)
+            nbytes = bucket * self._kv_pos_bytes
+            if pc.budget and nbytes > pc.budget:
+                continue  # would be refused: skip the device copy outright
+            k, v = self._slice_prefix(row_cache, bucket, src_row)
+            pc.insert(_PrefixEntry(
+                ids=hid, pixels_key=pk, has_event=has_ev,
+                kv={"k": k, "v": v}, length=hlen, bucket=bucket,
+                nbytes=nbytes,
+            ))
+
+    def _slice_prefix(self, cache, bucket: int, src_row: int = 0):
+        """(k, v) blocks of cache positions [0, bucket) at batch row
+        ``src_row`` — the entry-copy primitive (sharded variant pins the
+        block placement, ``parallel/serving.prefix_block_sharding``)."""
+        row_arr = jnp.asarray(src_row, jnp.int32)
+        if self.mesh is not None:
+            quant = isinstance(cache["k"], dict)
+            block_sh = self._serving.prefix_block_sharding(
+                self.mesh, self.cfg.llama)
+            fn = _get_sharded_slice_prefix(bucket, block_sh, quant)
+            return fn(cache["k"], cache["v"], row_arr)
+        return _slice_prefix_jit(cache["k"], cache["v"], row_arr, bucket)
+
     def _finish_admission(self, req, row, prompt_len, row_cache,
-                          row_logits, row_hidden=None) -> None:
+                          row_logits, row_hidden=None,
+                          prefix_entry=None) -> None:
         """Insert the prefilled row into the shared cache + activate it."""
         if self.nan_check and not bool(
                 np.isfinite(np.asarray(jax.device_get(row_logits))).all()):
@@ -1869,6 +2611,7 @@ class ContinuousBatcher:
             self.frozen[row] = True
             self._finish_forced(req, STATUS_NAN)
             return
+        self._insert_prefix_on_prefill(req, row_cache)
         if self.mesh is not None:
             admit = _get_sharded_admit(
                 self._cache_flat_sh, self._cache_treedef, self._logits_sh
@@ -1878,8 +2621,22 @@ class ContinuousBatcher:
         self.cache, self.logits = admit(
             self.cache, self.logits, row, row_cache, row_logits
         )
+        self._activate_row(req, row, prompt_len, row_logits, row_hidden,
+                           prefix_entry)
+
+    def _activate_row(self, req, row, prompt_len, row_logits,
+                      row_hidden=None, prefix_entry=None) -> None:
+        """Post-insert activation bookkeeping, shared by the batch-1 and
+        wave admission paths."""
         self.rows[row] = req
         req.row = row
+        if prefix_entry is not None:
+            # Refcount pin (ISSUE 4 satellite): the entry must survive
+            # LRU pressure while this row decodes from its KV — a hot
+            # session's head is the worst possible victim. Drained by
+            # _record_finish on ANY terminal path.
+            prefix_entry.pins += 1
+            req.prefix_entry = prefix_entry
         obs_metrics.SERVE_ACTIVE_ROWS.set(
             sum(r is not None for r in self.rows))
         # Row activation below rewrites frozen/n_rem (and base_pos for

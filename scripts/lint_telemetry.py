@@ -18,6 +18,11 @@ Rule 2 — metric registration: every ``.counter(``/``.gauge(``/
 runtime tree (the obs/metrics.py central-catalogue rule: call sites
 import metric objects, they never register). Tests are excluded — they
 build private registries with throwaway names.
+
+Rule 3 — catalogue coverage (ISSUE 4 satellite): every registered
+``egpt_*`` metric has a row in OBSERVABILITY.md (literal name mention).
+An operator hunting a dashboard number must find its meaning in the
+catalogue; a metric that ships undocumented "passes" silently forever.
 """
 
 from __future__ import annotations
@@ -112,7 +117,25 @@ def run_lint(root: str) -> List[str]:
     if not seen:
         violations.append("no metric registrations found — the scan "
                           "pattern or tree layout changed under the lint")
+    _check_catalogue(root, seen, violations)
     return violations
+
+
+def _check_catalogue(root: str, seen: Dict[str, str],
+                     violations: List[str]) -> None:
+    """Rule 3: every registered egpt_* metric appears (by literal name)
+    in OBSERVABILITY.md's catalogue."""
+    doc_path = os.path.join(root, "OBSERVABILITY.md")
+    try:
+        with open(doc_path) as f:
+            doc = f.read()
+    except OSError:
+        doc = ""
+    for name, site in sorted(seen.items()):
+        if METRIC_NAME_RE.match(name) and name not in doc:
+            violations.append(
+                f"{site}: metric {name!r} has no catalogue row in "
+                f"OBSERVABILITY.md — document every registered metric")
 
 
 def main() -> int:

@@ -39,11 +39,18 @@ def test_lint_catches_each_violation_class(tmp_path):
         'R.gauge(\n    "egpt_ok_metric", "x")\n'
     )
     (pkg / "other.py").write_text('R.gauge("egpt_ok_metric", "again")\n')
+    # Catalogue doc mentions ONE of the metrics; the other (and the
+    # duplicate's name) must be flagged as undocumented (rule 3).
+    (tmp_path / "OBSERVABILITY.md").write_text(
+        "| `egpt_documented_metric` | gauge | — | covered |\n")
+    (pkg / "doc.py").write_text('R.gauge("egpt_documented_metric", "x")\n')
     v = lint.run_lint(str(tmp_path))
     assert any("time.time()" in s for s in v)
     assert any("from time import time" in s for s in v)
     assert any("'Bad-Name' does not match" in s for s in v)
     assert any("registered twice" in s for s in v)
+    assert any("'egpt_ok_metric' has no catalogue row" in s for s in v)
+    assert not any("egpt_documented_metric" in s for s in v)
 
 
 def test_lint_fails_closed_when_nothing_found(tmp_path):

@@ -210,8 +210,12 @@ def test_chunked_prefill_decode_progresses_across_admission(tiny):
     committing tokens every scheduler step (the whole point of chunking:
     a long prompt cannot stall the batch for its full prefill)."""
     cfg, params = tiny
+    # prefix_cache off: with insert-on-prefill, B's shared text head
+    # ([1, 5]) would hit the cache and admit via the (cheap, one-shot)
+    # suffix path instead of exercising the chunked machinery under test.
     srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=2,
-                            eos_token_id=None, prefill_chunk=8)
+                            eos_token_id=None, prefill_chunk=8,
+                            prefix_cache=False)
     a = srv.submit([1, 5, -200, 9], _pv(cfg, 0), 12)
     srv.step()  # admit A (no actives yet -> one-shot prefill), decode 2
     req_a = next(r for r in srv.rows if r is not None and r.rid == a)
@@ -621,6 +625,101 @@ def test_pipelined_deadline_and_cancel_at_dispatch_boundary(tiny):
     assert out[late] == _oneshot(params, cfg, [3, -200, 11, 4],
                                  _pv(cfg, 2), 6)
     assert out[cancel_me] == []
+
+
+# -- prefix-KV cache (ISSUE 4) --------------------------------------------
+
+
+_CACHE_CONFIGS = {
+    "greedy": dict(),
+    "int8_kv": dict(kv_quant=True),
+    "speculative": dict(speculative=4),
+    "ttft_ramp": dict(first_chunk=2),
+    "chunked_prefill": dict(prefill_chunk=8),
+    "sync": dict(pipeline=False),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_CACHE_CONFIGS))
+def test_prefix_cache_on_off_matrix(tiny, name):
+    """ISSUE 4 exactness contract: with the radix prefix cache auto-
+    populating on admission prefill (multi-session traffic: two streams,
+    repeat requests, a wrong-stream request and a non-matching prompt),
+    every configuration commits chains byte-identical to the cache-off
+    server AND to one-shot generate. Caching may only change WHERE a
+    prompt's KV comes from, never its values."""
+    cfg, params = tiny
+    kw = _CACHE_CONFIGS[name]
+    reqs = [
+        ([1, 5, -200, 9, 9], _pv(cfg, 0), 8),
+        ([1, 5, -200, 9, 9], _pv(cfg, 1), 8),   # same text, OTHER stream
+        ([1, 5, -200, 3], _pv(cfg, 0), 7),      # session-0 repeat: hit
+        ([2, 6, -200, 11], _pv(cfg, 2), 6),     # non-matching head
+        ([1, 5, -200, 9, 9], _pv(cfg, 1), 8),   # session-1 repeat: hit
+    ]
+    outs = {}
+    for cache in (True, False):
+        srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256,
+                                chunk=4, eos_token_id=None,
+                                prefix_cache=cache, **kw)
+        rids = [srv.submit(i, p, b) for i, p, b in reqs]
+        out = srv.run_until_drained()
+        outs[cache] = [out[r] for r in rids]
+        if cache:
+            assert srv._prefix_cache.hits >= 2, name
+    assert outs[True] == outs[False], name
+    for got, (i, p, b) in zip(outs[True], reqs):
+        assert got == _oneshot(params, cfg, i, p, b), name
+
+
+def test_prefix_cache_medusa_draft_head(tiny):
+    """Trained-head drafting rides the suffix-admission path (the hit's
+    last hidden seeds the draft window) — exactness must hold with the
+    cache populating itself across sessions."""
+    cfg, params = tiny
+    heads = {"w": jax.random.normal(jax.random.PRNGKey(3),
+                                    (3, cfg.llama.hidden_size,
+                                     cfg.llama.hidden_size)) * 0.5}
+    reqs = [
+        ([1, 5, -200, 9, 9], _pv(cfg, 0), 8),
+        ([1, 5, -200, 3], _pv(cfg, 0), 7),
+        ([1, 5, -200, 9, 9], _pv(cfg, 1), 8),
+    ]
+    outs = {}
+    for cache in (True, False):
+        srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256,
+                                chunk=4, eos_token_id=None, speculative=4,
+                                draft_head=heads, prefix_cache=cache)
+        rids = [srv.submit(i, p, b) for i, p, b in reqs]
+        out = srv.run_until_drained()
+        outs[cache] = [out[r] for r in rids]
+    assert outs[True] == outs[False]
+    for got, (i, p, b) in zip(outs[True], reqs):
+        assert got == _oneshot(params, cfg, i, p, b)
+
+
+def test_set_prefix_coexists_with_auto_entries_and_warmup(tiny):
+    """Operator-set entries (set_prefix / POST /prefix) and auto-inserted
+    heads share the trie; warmup precompiles one suffix executable per
+    distinct entry shape; chains stay exact through both."""
+    cfg, params = tiny
+    system = [1, 5, 7, 7, 8]
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None)
+    srv.set_prefix(system)
+    srv.set_prefix(system + [4])  # a second, deeper operator entry
+    n = srv.warmup(prompt_lens=[16])
+    assert n >= 2
+    reqs = [
+        (system + [4, -200, 9, 9], _pv(cfg, 0), 8),   # deeper entry wins
+        (system + [-200, 11, 3], _pv(cfg, 1), 7),
+        (system + [4, -200, 9, 9], _pv(cfg, 0), 8),   # event-head hit now
+    ]
+    rids = [srv.submit(i, p, b) for i, p, b in reqs]
+    out = srv.run_until_drained()
+    for rid, (i, p, b) in zip(rids, reqs):
+        assert out[rid] == _oneshot(params, cfg, i, p, b)
+    assert srv._prefix_cache.hits == len(reqs)
 
 
 def test_pipelined_overlap_counters(tiny):

@@ -379,6 +379,57 @@ def test_dispatch_fault_streak_trips_breaker_then_recovers(tiny):
         eng.shutdown()
 
 
+def test_prefix_copy_fault_drains_and_entry_stays_exact(tiny):
+    """ISSUE 4 chaos: a fault at the new ``serve.prefix_copy`` site fires
+    while a prefix-cache hit is being admitted (row reserved, entry about
+    to be copied). The engine must fail the in-flight request cleanly,
+    restart the scheduler, and — because entry KV is never donated to any
+    jit — the entry must NOT be corrupted: the next hit against it
+    serves the byte-identical chain a fault-free engine produces."""
+    from eventgpt_tpu.data.conversation import prepare_event_prompt
+    from eventgpt_tpu.constants import DEFAULT_EV_START_TOKEN
+
+    cfg, params = tiny
+    head = prepare_event_prompt(
+        "What is happening?", "eventgpt_v1"
+    ).split(DEFAULT_EV_START_TOKEN)[0]
+
+    # Fault-free reference: same prefix entry, same query, twice (the
+    # second request is a cache hit through the same suffix path).
+    ref = _engine(tiny)
+    try:
+        assert ref.set_prefix(head) > 0
+        r1 = ref.submit("What is happening?", _pv(cfg), 6)
+        want = ref.result(r1, timeout=120)
+        r2 = ref.submit("What is happening?", _pv(cfg), 6)
+        assert ref.result(r2, timeout=120) == want  # r2 hit the entry
+        assert ref.batcher._prefix_cache.hits >= 1
+    finally:
+        ref.shutdown()
+
+    faults.configure("serve.prefix_copy:n=1")  # first hit admission faults
+    eng = _engine(tiny, breaker_threshold=3, breaker_cooldown_s=0.5)
+    try:
+        assert eng.set_prefix(head) > 0
+        doomed = eng.submit("What is happening?", _pv(cfg), 6)
+        with pytest.raises(RuntimeError, match="InjectedFault"):
+            eng.result(doomed, timeout=120)
+        assert eng.batcher._inflight is None   # pipeline drained/aborted
+        assert eng.n_faults == 1 and not eng.breaker_open()
+        st = faults.stats()["serve.prefix_copy"]
+        assert st["fires"] == 1
+        # The entry survived uncorrupted AND unpinned (the engine sweep
+        # drains the refcount of the failed row): the next hit is exact.
+        entries = eng.batcher._prefix_cache.entries()
+        assert len(entries) == 1 and entries[0].pins == 0
+        rid = eng.submit("What is happening?", _pv(cfg), 6)
+        assert eng.result(rid, timeout=120) == want
+        assert eng.batcher._prefix_cache.hits >= 1
+        assert eng.n_restarts >= 1
+    finally:
+        eng.shutdown()
+
+
 def test_pipelined_chains_survive_dispatch_fault_exactly(tiny):
     """After a mid-pipeline fault + restart, the next request's chain is
     byte-identical to an untouched batcher's — the aborted carry must

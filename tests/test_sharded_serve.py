@@ -150,6 +150,46 @@ def test_sharded_server_all_features_composed(tiny, mesh8):
         assert out[rid] == want, f"req {rid}"
 
 
+def test_sharded_prefix_cache_and_wave_admission(tiny, mesh8):
+    """ISSUE 4 sharded-dryrun leg: the prefix-KV cache (entry copy via
+    the pinned ``_get_sharded_slice_prefix`` / ``_get_sharded_prefix_
+    prefill`` jits) and the batched admission wave (``_get_sharded_
+    admit_wave``) compose with the serving mesh — multi-session chains
+    byte-identical to the single-chip server and one-shot generate, and
+    a wrong-stream request falls back to full prefill."""
+    cfg, params = tiny
+    sharded = shard_params_for_serving(params, cfg, mesh8)
+    reqs = [
+        ([1, 5, -200, 9, 9], 0, 8),
+        ([1, -200, 7, 7], 1, 6),
+        ([3, -200, 11], 2, 7),        # 3 distinct heads -> one wave
+        ([1, 5, -200, 3], 0, 6),      # session-0 repeat -> event-head hit
+        ([1, 5, -200, 9, 9], 3, 8),   # same text, WRONG stream
+    ]
+    srvm = ContinuousBatcher(sharded, cfg, mesh=mesh8, max_batch=4,
+                             max_len=256, chunk=4, eos_token_id=None)
+    srv1 = ContinuousBatcher(params, cfg, max_batch=4, max_len=256,
+                             chunk=4, eos_token_id=None)
+    ridsm = [srvm.submit(ids, _pv(cfg, s), b) for ids, s, b in reqs]
+    rids1 = [srv1.submit(ids, _pv(cfg, s), b) for ids, s, b in reqs]
+    outm = srvm.run_until_drained()
+    out1 = srv1.run_until_drained()
+    for rm, r1, (ids, s, b) in zip(ridsm, rids1, reqs):
+        want = _oneshot(params, cfg, ids, _pv(cfg, s), b)
+        assert outm[rm] == want
+        assert out1[r1] == want
+    assert srvm._prefix_cache.hits >= 1
+    assert srvm._prefix_cache.n_entries >= 4
+    # Batched SUFFIX wave under the mesh: two session repeats admitted at
+    # one boundary hit two different entries and run one stacked
+    # suffix-prefill dispatch (_get_sharded_prefix_prefill at batch 2).
+    again = [([1, 5, -200, 9, 9], 0, 6), ([1, -200, 7, 7], 1, 6)]
+    ridsw = [srvm.submit(ids, _pv(cfg, s), b) for ids, s, b in again]
+    outw = srvm.run_until_drained()
+    for rw, (ids, s, b) in zip(ridsw, again):
+        assert outw[rw] == _oneshot(params, cfg, ids, _pv(cfg, s), b)
+
+
 def test_13b_sharded_server_segment_compiles():
     """The 13B decode segment — the BASELINE config-5 serving hot loop —
     AOT-compiles over an fsdp=4 x model=2 mesh from abstract sharded
