@@ -246,6 +246,10 @@ class ServingEngine:
             # Pipelined-scheduler overlap story (PERFORMANCE.md): how much
             # host scheduling the in-flight segment is hiding.
             "pipeline": bool(getattr(b, "pipeline", False)),
+            # Stall-free admission (ISSUE 5): live piggyback lanes and
+            # the per-boundary prompt-token budget driving them.
+            "prefill_budget": getattr(b, "prefill_budget", 0),
+            "lanes": len(getattr(b, "_lanes", ()) or ()),
             "overlap_ratio": round(b.overlap_ratio(), 3)
             if hasattr(b, "overlap_ratio") else 0.0,
             **({"spec_tokens_per_iteration":
@@ -373,6 +377,13 @@ class ServingEngine:
             # resuming from stale device state.
             if hasattr(b, "abort_pipeline"):
                 b.abort_pipeline()
+            if getattr(b, "_lanes", None):
+                # Piggybacked admissions mid-prefill: their requests are
+                # failed by the rows sweep below (the row is reserved);
+                # drop the lane records so the restarted scheduler never
+                # tries to finish a dead lane.
+                b._lanes.clear()
+                b._lane_free = list(range(b._lane_cap))
             failed = []
             for r, req in enumerate(b.rows):
                 if req is None:
@@ -852,6 +863,11 @@ def build_server(args) -> tuple:
         prefix_cache=not getattr(args, "no_prefix_cache", False),
         prefix_cache_bytes=int(
             getattr(args, "prefix_cache_mb", 512.0) * 1024 * 1024),
+        # Stall-free admission (ISSUE 5): -1 = auto (one segment's worth
+        # of prompt tokens per boundary), 0 = off (exclusive waves).
+        prefill_budget=(args.chunk
+                        if getattr(args, "prefill_budget", -1) < 0
+                        else int(args.prefill_budget)),
     )
     if args.warmup:
         t0 = time.perf_counter()
@@ -922,6 +938,14 @@ def main(argv=None):
                    help="trained Medusa head stack (.npz) for speculative "
                         "drafting (requires --speculative > 0)")
     p.add_argument("--prefill_chunk", type=int, default=0)
+    p.add_argument("--prefill_budget", type=int, default=-1,
+                   help="stall-free admission (ISSUE 5): prompt tokens "
+                        "folded into each decode dispatch as piggyback "
+                        "prefill lanes while rows are decoding (mixed "
+                        "segments). -1 = auto (--chunk tokens per "
+                        "boundary, the default); 0 = off — every "
+                        "admission runs the exclusive wave/suffix path "
+                        "(the A/B escape hatch)")
     p.add_argument("--first_chunk", type=int, default=0,
                    help="TTFT ramp: short segment length while a fresh "
                         "admission owes its first token (0 = off; "

@@ -30,11 +30,18 @@ Examples::
     EGPT_FAULTS="serve.admit:p=0.1,times=3"   # ~10% of admissions, max 3
     EGPT_FAULTS="train.step:delay=0.05"       # every micro-step +50 ms
 
-Wired sites (grep ``maybe_fail(`` for the authoritative list):
-``serve.step`` / ``serve.admit`` / ``serve.dispatch``
-(``ContinuousBatcher``; the last fires at the pipelined scheduler's
-segment-dispatch boundary — a fault there can land with a segment still
-in flight, the window the engine's abort/restart path must survive),
+Wired sites (grep ``maybe_fail(`` for the authoritative list; the
+telemetry lint's rule 4 asserts every one of them is exercised by a
+chaos/faults test):
+``serve.step`` / ``serve.admit`` / ``serve.dispatch`` /
+``serve.mixed_dispatch``
+(``ContinuousBatcher``; ``serve.dispatch`` fires at the pipelined
+scheduler's segment-dispatch boundary — a fault there can land with a
+segment still in flight, the window the engine's abort/restart path must
+survive; ``serve.mixed_dispatch`` fires at the piggyback lane-advance
+boundary of a mixed segment — the batcher degrades that boundary to a
+plain decode dispatch and re-queues the admitting lanes, decode rows
+untouched), ``serve.prefix_copy`` (prefix-cache entry copy at admission),
 ``serve.loop`` (``ServingEngine`` scheduler thread), ``multiproc.launch``
 / ``multiproc.worker`` (``parallel/multiproc.py`` bootstrap), and
 ``train.step`` (``Trainer`` micro-batch boundary).

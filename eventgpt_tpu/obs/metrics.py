@@ -430,7 +430,20 @@ SERVE_PREFILL_DISPATCHES = REGISTRY.counter(
     "egpt_serve_prefill_dispatches_total",
     "Admission prefill dispatches by kind: full (batch-1), wave (one per "
     "BATCH of admissions), chunk (per chunked-prefill advance), suffix "
-    "(prefix-cache hit)")
+    "(prefix-cache hit), piggyback (mixed segment carrying prefill lanes)")
+# -- stall-free admission: mixed prefill+decode segments (ISSUE 5) --
+SERVE_MIXED_SEGMENTS = REGISTRY.counter(
+    "egpt_serve_mixed_segments_total",
+    "Dispatched MIXED segments: decode/spec body plus live piggyback "
+    "prefill lanes in one executable")
+SERVE_MIXED_LANES = REGISTRY.histogram(
+    "egpt_serve_mixed_lane_rows",
+    "Piggyback prefill lanes advanced per mixed segment",
+    ROWS_BUCKETS)
+SERVE_MIXED_PREFILL_TOKENS = REGISTRY.counter(
+    "egpt_serve_mixed_prefill_tokens_total",
+    "Prompt positions prefilled inside mixed segments (piggyback lanes), "
+    "bounded per boundary by --prefill_budget")
 
 # -- fault injection (eventgpt_tpu/faults.py) --
 FAULT_TRIPS = REGISTRY.counter(

@@ -42,9 +42,9 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 # jax 0.4.x ships the Mosaic compile options as TPUCompilerParams; newer
-# releases renamed it to CompilerParams. Same fields either way.
-_CompilerParams = (getattr(pltpu, "CompilerParams", None)
-                   or pltpu.TPUCompilerParams)
+# releases renamed it to CompilerParams. Same fields either way — the
+# shim lives in eventgpt_tpu/compat.py with the other version shims.
+from eventgpt_tpu.compat import pallas_compiler_params as _CompilerParams
 
 
 def _decode_attn_kernel(li_ref, nv_ref, q_ref, kq_ref, ks_ref, vq_ref,

@@ -28,6 +28,13 @@ Layout decisions specific to serving:
     whose value is long-context *training*; serving prompts sit far below
     the 2048 context cap and the decode hot loop attends to the whole
     cache from a single query token.
+  * The stall-free-admission lane buffers (ISSUE 5: the resident
+    (K_cap, S_lane) lane KV cache and (K_cap, S_lane, D) prompt-embed
+    buffer that mixed segments advance) place through the SAME helpers —
+    ``shard_kv_cache`` at batch K_cap and ``shard_batch_array`` — and
+    the mixed-segment jits (``serve._get_sharded_mixed_*``) pin their
+    lane outputs to that placement, so the donated lane buffers keep
+    aliasing across boundaries exactly like the resident decode cache.
 """
 
 from __future__ import annotations
@@ -259,8 +266,11 @@ def serving_flash_shard_map(mesh: Mesh, batch: int, num_heads: Optional[int] = N
 
     # check_vma=False: the pallas_call's out ShapeDtypeStruct carries no
     # varying-mesh-axes annotation, and the kernel is purely local anyway
-    # (no collectives inside).
-    return jax.shard_map(
+    # (no collectives inside). compat.shard_map falls back to the 0.4.x
+    # experimental home (check_rep) on builds without jax.shard_map.
+    from eventgpt_tpu.compat import shard_map
+
+    return shard_map(
         local, mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, valid_spec),
         out_specs=qkv_spec,

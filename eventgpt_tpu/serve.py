@@ -43,6 +43,25 @@ TPU-shaped design (everything jit-visible is static-shape):
     dispatch boundary run as ONE padded batched prefill (``_admit_wave``
     — N x ~100 ms dispatch tax -> ~100 ms per wave), scattered into the
     shared cache in one more dispatch.
+  * STALL-FREE ADMISSION (ISSUE 5): when ``prefill_budget > 0`` and rows
+    are actively decoding, admissions no longer pause the batch for an
+    exclusive prefill/suffix wave. Each admitting request becomes a
+    piggyback LANE: its prompt embeddings (for a prefix-cache hit, the
+    entry's KV copy is the lane's starting offset and only the suffix
+    embeds load) sit in a resident (K, S_lane, D) buffer, and every
+    decode dispatch becomes a MIXED segment — the existing decode/spec
+    body plus a batched ``decode_kstep`` advancing each live lane by
+    ``chunk_p`` prompt positions against its own lane-cache row, all in
+    ONE executable (compiled per (batch, chunk, K, S_lane, chunk_p)
+    bucket). In-flight rows therefore commit tokens at every admission
+    boundary; the per-boundary prompt-token budget is
+    ``K_cap * chunk_p <= prefill_budget``. A finished lane joins the
+    shared cache through the same scatter/activation path as every other
+    admission (NaN quarantine, insert-on-prefill, Medusa seeding, TTFT
+    ramp), so chains stay byte-identical to the exclusive paths. With no
+    active decode rows (nothing to stall) the scheduler still picks the
+    wave/exclusive prefill — fastest to completion; the policy chooses
+    per boundary.
   * PIPELINED scheduling (default): the between-segment control state
     (frozen mask, per-row budgets, gather base) is ALSO device-resident,
     updated in-graph by the segment kernels, so segment N+1 dispatches
@@ -646,6 +665,174 @@ _chunk_prefill_jit = functools.partial(
 )(_chunk_prefill)
 
 
+def _lane_advance(params, cfg: EventChatConfig, lane_embeds, lane_cache,
+                  start, new_len, last_idx, chunk_p: int):
+    """One piggybacked chunked-prefill advance over the K resident lanes
+    (ISSUE 5): each lane row gathers its own ``chunk_p``-wide window of
+    prompt embeddings at ``start`` and runs it through ``decode_kstep``
+    against its own lane-cache row — the batched form of
+    ``_chunk_prefill``, with the same pad rule (trailing positions past
+    the prompt write garbage above ``new_len``, masked from every future
+    read). ``start`` is authoritative for the write base (the carried
+    lane-cache length is overwritten), so idle/ready lane slots passed
+    with ``start == new_len`` advance nothing real — their garbage writes
+    land above their pinned length. Gather indices clip at the buffer
+    edge, which only ever touches pad positions (the batcher sizes the
+    lane bucket to hold every member's prompt).
+
+    Returns (last_logits (K, V), last_hidden (K, D), lane_cache) — the
+    last-real-token row of each lane's window, meaningful only on a
+    lane's finishing chunk (the batcher slices it there).
+    """
+    k, s, _ = lane_embeds.shape
+    idx = jnp.clip(
+        start[:, None] + jnp.arange(chunk_p)[None, :], 0, s - 1
+    )
+    emb = jnp.take_along_axis(lane_embeds, idx[:, :, None], axis=1)
+    lane_cache = {**lane_cache, "length": start}
+    logits, hidden, lane_cache = llama_mod.decode_kstep(
+        params["llama"], cfg.llama, emb, lane_cache, return_hidden=True
+    )
+    last = jnp.take_along_axis(
+        logits, jnp.reshape(last_idx, (-1, 1, 1)), axis=1
+    )[:, 0]
+    last_hidden = jnp.take_along_axis(
+        hidden, jnp.reshape(last_idx, (-1, 1, 1)), axis=1
+    )[:, 0]
+    return last, last_hidden, {**lane_cache, "length": new_len}
+
+
+def _mixed_decode_segment(
+    params, cfg: EventChatConfig, logits, cache, key, frozen, n_rem,
+    lane_embeds, lane_cache, lane_start, lane_new_len, lane_last_idx,
+    chunk: int, chunk_p: int, eos_token_id: int,
+    temperature: float = 0.0, top_p: float = 1.0, nan_gate: bool = True,
+):
+    """The mixed-segment executable (ISSUE 5 tentpole, plain-decode
+    form): the unchanged ``_decode_segment`` body PLUS the piggybacked
+    prefill lanes, in one dispatch. The two halves touch disjoint state
+    (shared cache rows vs lane-cache rows; rows are independent in
+    attention), so XLA is free to interleave them and the decode rows'
+    tokens commit in the same dispatch that advances the admissions —
+    the stall class the exclusive prefill wave had is gone by
+    construction. Returns the decode outputs followed by the lane
+    outputs of ``_lane_advance``."""
+    dec = _decode_segment(
+        params, cfg, logits, cache, key, frozen, n_rem, chunk,
+        eos_token_id, temperature, top_p, nan_gate,
+    )
+    lane = _lane_advance(
+        params, cfg, lane_embeds, lane_cache, lane_start, lane_new_len,
+        lane_last_idx, chunk_p,
+    )
+    return dec + lane
+
+
+_mixed_decode_segment_jit = functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "chunk", "chunk_p", "eos_token_id",
+                     "temperature", "top_p", "nan_gate"),
+    donate_argnames=("cache", "lane_cache"),
+)(_mixed_decode_segment)
+
+
+def _mixed_spec_segment(
+    params, cfg: EventChatConfig, cache, key, ids_buf, base_pos, frozen,
+    n_rem, lane_embeds, lane_cache, lane_start, lane_new_len,
+    lane_last_idx, n_iters: int, window: int, chunk_p: int,
+    eos_token_id: int, temperature: float = 0.0, top_p: float = 1.0,
+    history=None, medusa=None, drafts=None,
+):
+    """Mixed segment, speculative form: ``_spec_segment`` + the
+    piggybacked prefill lanes in one dispatch (see
+    ``_mixed_decode_segment``)."""
+    spec = _spec_segment(
+        params, cfg, cache, key, ids_buf, base_pos, frozen, n_rem,
+        n_iters, window, eos_token_id, temperature, top_p,
+        history=history, medusa=medusa, drafts=drafts,
+    )
+    lane = _lane_advance(
+        params, cfg, lane_embeds, lane_cache, lane_start, lane_new_len,
+        lane_last_idx, chunk_p,
+    )
+    return spec + lane
+
+
+_mixed_spec_segment_jit = functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "n_iters", "window", "chunk_p",
+                     "eos_token_id", "temperature", "top_p"),
+    donate_argnames=("cache", "lane_cache"),
+)(_mixed_spec_segment)
+
+
+def _lane_seed(lane_cache, slot, pk, pv):
+    """Copy a prefix-cache entry's KV block into lane row ``slot`` at
+    position 0 — the 'suffix copies become the piggybacked lane's
+    starting offset' rule (ISSUE 5): the lane then advances only the
+    suffix, reading the seeded prefix through ``decode_kstep``'s
+    attention window exactly as ``_prefix_prefill`` would. The lane
+    cache is ALWAYS unquantized (see ``_lane_extract``), so an int8
+    entry block dequantizes here — the same values the exclusive suffix
+    path's attention reads."""
+
+    def ins(buf, src):
+        if isinstance(src, dict):  # int8 entry into the unquant lane
+            src = llama_mod._kv_dequant(src, buf.dtype)
+        return lax.dynamic_update_slice(
+            buf, src.astype(buf.dtype),
+            (0, slot, 0) + (0,) * (buf.ndim - 3),
+        )
+
+    return {"k": ins(lane_cache["k"], pk), "v": ins(lane_cache["v"], pv),
+            "length": lane_cache["length"]}
+
+
+_lane_seed_jit = functools.partial(
+    jax.jit, donate_argnames=("lane_cache",)
+)(_lane_seed)
+
+
+def _lane_extract(lane_k, lane_v, slot, pk, pv, bucket: int, quant: bool,
+                  plen: int = 0):
+    """Slice lane row ``slot`` into a (1, bucket) admission row cache.
+
+    The lane prefills UNQUANTIZED even on an int8-KV server: one-shot
+    ``prefill`` attends over full-precision K/V and quantizes only at
+    the cache write, so a lane that quantized per chunk (as
+    ``decode_kstep`` does on a quant cache) would read back dequantized
+    values mid-prompt and drift off the one-shot chain. Instead the
+    quantization happens ONCE, here, from the same full-precision values
+    prefill's write sees — byte-identical resident rows. A seeded prefix
+    entry's ORIGINAL (q, s) block overlays its region afterwards, so the
+    prefix lands exactly as the exclusive suffix path copies it (a
+    requantize of the dequantized seed could wobble the scales). Only
+    the entry's REAL region [0, plen) overlays — its stored block is
+    bucket-length with pad above ``plen``, which must not clobber the
+    lane's freshly-prefilled suffix positions."""
+    k, v = _slice_prefix_block(lane_k, lane_v, slot, bucket)
+    if quant:
+        k, v = llama_mod._kv_quantize(k), llama_mod._kv_quantize(v)
+
+        def overlay(buf, src):
+            if isinstance(buf, dict):
+                return {"q": overlay(buf["q"], src["q"]),
+                        "s": overlay(buf["s"], src["s"])}
+            src = src[:, :, :plen]
+            return lax.dynamic_update_slice(
+                buf, src.astype(buf.dtype), (0,) * buf.ndim
+            )
+
+        if pk is not None:
+            k, v = overlay(k, pk), overlay(v, pv)
+    return k, v
+
+
+_lane_extract_jit = functools.partial(
+    jax.jit, static_argnames=("bucket", "quant", "plen")
+)(_lane_extract)
+
+
 def _prefix_prefill(params, cfg: EventChatConfig, pk, pv, plen,
                     cache, suffix_embeds, new_len, last_idx):
     """Admission with a shared-prefix KV seed (VERDICT r4 #7): copy the
@@ -833,6 +1020,107 @@ def _get_sharded_slice_prefix(bucket, block_sh, quant):
     )
 
 
+@functools.lru_cache(maxsize=16)
+def _get_sharded_mixed_decode_segment(
+    cfg, chunk, chunk_p, eos_token_id, temperature, top_p, nan_gate,
+    flat_cache_sh, cache_treedef, logits_sh, toks_sh, b_sh, key_sh,
+    flat_lane_sh, lane_treedef, lane_emb_sh, lane_last_sh, lane_hidden_sh,
+):
+    """Mixed decode segment under the serving mesh: the decode half pins
+    the same carry/cache shardings as ``_get_sharded_decode_segment``;
+    the lane half pins the lane cache to its resident placement
+    (``parallel/serving.shard_kv_cache`` at batch K) so the donated lane
+    buffers keep aliasing across boundaries."""
+    cache_sh = jax.tree_util.tree_unflatten(cache_treedef, list(flat_cache_sh))
+    lane_sh = jax.tree_util.tree_unflatten(lane_treedef, list(flat_lane_sh))
+    return jax.jit(
+        lambda params, logits, cache, key, frozen, n_rem, lane_embeds,
+        lane_cache, lane_start, lane_new_len, lane_last_idx:
+        _mixed_decode_segment(
+            params, cfg, logits, cache, key, frozen, n_rem, lane_embeds,
+            lane_cache, lane_start, lane_new_len, lane_last_idx,
+            chunk, chunk_p, eos_token_id, temperature, top_p, nan_gate,
+        ),
+        donate_argnums=(2, 7),
+        out_shardings=(toks_sh, b_sh, b_sh, b_sh, logits_sh, cache_sh,
+                       key_sh, b_sh, b_sh,
+                       lane_last_sh, lane_hidden_sh, lane_sh),
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _get_sharded_mixed_spec_segment(
+    cfg, n_iters, window, chunk_p, eos_token_id, temperature, top_p,
+    flat_cache_sh, cache_treedef, ids_sh, b_sh, key_sh, drafts_sh,
+    flat_lane_sh, lane_treedef, lane_emb_sh, lane_last_sh, lane_hidden_sh,
+):
+    cache_sh = jax.tree_util.tree_unflatten(cache_treedef, list(flat_cache_sh))
+    lane_sh = jax.tree_util.tree_unflatten(lane_treedef, list(flat_lane_sh))
+    scalar_sh = jax.sharding.NamedSharding(
+        key_sh.mesh, jax.sharding.PartitionSpec()
+    )
+    return jax.jit(
+        lambda params, cache, key, ids_buf, base_pos, frozen, n_rem,
+        history, medusa, drafts, lane_embeds, lane_cache, lane_start,
+        lane_new_len, lane_last_idx:
+        _mixed_spec_segment(
+            params, cfg, cache, key, ids_buf, base_pos, frozen, n_rem,
+            lane_embeds, lane_cache, lane_start, lane_new_len,
+            lane_last_idx, n_iters, window, chunk_p, eos_token_id,
+            temperature, top_p, history=history, medusa=medusa,
+            drafts=drafts,
+        ),
+        donate_argnums=(1, 11),
+        out_shardings=(ids_sh, b_sh, b_sh, cache_sh, key_sh, drafts_sh,
+                       scalar_sh, b_sh, b_sh, b_sh,
+                       lane_last_sh, lane_hidden_sh, lane_sh),
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _get_sharded_lane_extract(bucket, quant, block_sh, plen):
+    """Lane-row extraction under a mesh, with the admission row-cache
+    block pinned to the prefix-entry placement (same reasoning as
+    ``_get_sharded_slice_prefix``)."""
+    out_sh = ({"q": block_sh, "s": block_sh} if quant else block_sh)
+    return jax.jit(
+        lambda k, v, slot, pk, pv: _lane_extract(
+            k, v, slot, pk, pv, bucket, quant, plen),
+        out_shardings=(out_sh, out_sh),
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _get_sharded_lane_seed(flat_lane_sh, lane_treedef):
+    """Entry-KV seed of one lane row with the lane cache's placement
+    pinned (the donated-buffer aliasing rule, same as every other
+    resident-state jit here)."""
+    lane_sh = jax.tree_util.tree_unflatten(lane_treedef, list(flat_lane_sh))
+    return jax.jit(
+        _lane_seed, donate_argnums=(0,), out_shardings=lane_sh,
+    )
+
+
+@dataclass
+class _PendingLane:
+    """One piggybacked admission (ISSUE 5): the row is reserved (frozen),
+    the prompt embeddings sit in lane-embeds slot ``slot``, and every
+    mixed segment advances the lane ``chunk_p`` prompt positions against
+    its lane-cache row until ``filled >= prompt_len`` — then the lane's
+    row cache is sliced out and joins the shared cache through the
+    normal admission tail (``_finish_admission``). For a prefix-cache
+    hit, the entry's KV was seeded at [0, filled0) and only the suffix
+    embeds were loaded."""
+    req: "_Request"
+    row: int
+    slot: int
+    prompt_len: int
+    filled: int = 0
+    entry: Optional["_PrefixEntry"] = None
+    last_logits: Any = None   # (1, V) future, valid after the final chunk
+    last_hidden: Any = None   # (1, D) future, Medusa seeding
+
+
 @dataclass
 class _PendingAdmission:
     """A chunked admission in flight: the row is reserved (frozen), the
@@ -919,6 +1207,8 @@ class ContinuousBatcher:
         prefix_cache: bool = True,
         prefix_cache_bytes: int = 0,
         prefix_insert: bool = True,
+        prefill_budget: int = 0,
+        prefill_lane_chunk: int = 0,
     ):
         if prefill_chunk and (2 * SEQ_BUCKET) % prefill_chunk:
             # A chunk that does not divide the bucket grain would force
@@ -1073,6 +1363,28 @@ class ContinuousBatcher:
         # identical chains either way (rows are independent in attention
         # and greedy decode is deterministic per row).
         self.pipeline = bool(pipeline)
+        # Stall-free admission (ISSUE 5): a per-boundary prompt-token
+        # budget folded into the decode dispatch itself. 0 = off (every
+        # admission runs the exclusive wave/suffix/chunked paths — the
+        # A/B escape hatch and the library default). When on, up to
+        # ``_lane_cap`` admissions ride as piggyback lanes, each advanced
+        # ``_lane_chunk`` prompt positions per mixed segment, so
+        # lanes * chunk_p <= prefill_budget tokens of prefill land per
+        # boundary while every in-flight row keeps committing tokens.
+        self.prefill_budget = max(int(prefill_budget), 0)
+        lane_chunk = int(prefill_lane_chunk) or min(
+            self.prefill_budget, SEQ_BUCKET)
+        self._lane_chunk = (
+            max(1, min(lane_chunk, self.prefill_budget))
+            if self.prefill_budget else 0)
+        self._lane_cap = (
+            max(1, min(self.prefill_budget // self._lane_chunk, max_batch))
+            if self.prefill_budget else 0)
+        self._lanes: List[_PendingLane] = []
+        self._lane_free: List[int] = list(range(self._lane_cap))
+        self._lane_cache = None       # resident (K_cap, S_lane) KV rows
+        self._lane_embeds = None      # resident (K_cap, S_lane, D) embeds
+        self._lane_bucket = 0         # S_lane: grown to the largest member
         self._inflight: Optional[dict] = None  # dispatched, unharvested
         # (frozen, n_rem, base_pos) device arrays as of the LAST dispatch;
         # None = stale (host mutated rows) -> rebuilt from the host mirror
@@ -1263,6 +1575,19 @@ class ContinuousBatcher:
             )
             jax.block_until_ready(rec["n_new"])
             n += 1
+        if self.prefill_budget:
+            # Mixed-segment executables (ISSUE 5): idle lanes against the
+            # largest requested prompt bucket — the decode half exits at
+            # entry, the lane half runs a garbage chunk above length 0
+            # (masked); nothing touches resident rows.
+            self._ensure_lane_buffers(buckets[-1])
+            for ck in chunks:
+                rec = self._dispatch_segment(
+                    chunk=ck, carry=tuple(warm_carry), record_carry=False,
+                    probe_faults=False, warm_mixed=True,
+                )
+                jax.block_until_ready(rec["n_new"])
+                n += 1
         self._dev_carry = None
         if self._prefix_cache is not None and self._prefix_cache.n_entries:
             # Prefix-admission (suffix) executables, one per distinct
@@ -1679,6 +2004,16 @@ class ContinuousBatcher:
             self.rows[p.row] = None  # row stays frozen; cache untouched
             self._finish_forced(p.req, STATUS_CANCELLED)
             return True
+        for l in self._lanes:
+            if l.req.rid == rid:
+                # A piggybacked admission mid-prefill: drop the lane and
+                # free the reserved row (same contract as a cancelled
+                # pending chunked admission — no tokens were committed).
+                self._lanes.remove(l)
+                self._lane_free.append(l.slot)
+                self.rows[l.row] = None
+                self._finish_forced(l.req, STATUS_CANCELLED)
+                return True
         for r, req in enumerate(self.rows):
             if req is not None and req.rid == rid:
                 # Cancelling an ACTIVE row mutates frozen/n_rem: settle
@@ -1743,6 +2078,18 @@ class ContinuousBatcher:
         self.host_gap_s = 0.0
         self.overlap_hidden_s = 0.0
         self._t_prev_fetch_end: Optional[float] = None
+        # Stall-free admission evidence (ISSUE 5, definitions in
+        # PERFORMANCE.md "Stall-free admission"): mixed_boundaries counts
+        # harvested segments that carried live piggyback lanes alongside
+        # live decode rows; mixed_zero_harvests counts those where the
+        # decode rows committed ZERO tokens — by construction this stays
+        # 0 (a live row commits at least one token per segment), and the
+        # bench asserts it: in-flight rows receive tokens during every
+        # admission boundary. mixed_prefill_tokens totals the prompt
+        # positions advanced inside mixed segments.
+        self.mixed_boundaries = 0
+        self.mixed_zero_harvests = 0
+        self.mixed_prefill_tokens = 0
 
     def overlap_ratio(self) -> float:
         """Fraction of host scheduler work hidden behind device compute
@@ -1770,12 +2117,20 @@ class ContinuousBatcher:
         """
         faults.maybe_fail("serve.step")
         faults.maybe_delay("serve.step")
+        piggy = (self.prefill_budget > 0
+                 and (bool(self._lanes) or not bool(self.frozen.all())))
         if self._inflight is not None and (
                 self._deadline_expired()
                 or self._pending is not None
-                or (self.queue and any(r is None for r in self.rows))):
+                or any(l.filled >= l.prompt_len for l in self._lanes)
+                or (self.queue and not piggy
+                    and any(r is None for r in self.rows))):
             # A forced finish or admission is about to mutate rows: apply
-            # it against settled state, at the dispatch boundary.
+            # it against settled state, at the dispatch boundary. A
+            # piggyback JOIN is exempt (ISSUE 5): it only reserves a row
+            # (host-side) and touches the lane buffers, never the decode
+            # carry — so lane boundaries keep the pipeline full; only a
+            # lane FINISH (activation) drains.
             self._drain()
         self._expire_deadlines()
         t0 = time.perf_counter()
@@ -1794,11 +2149,14 @@ class ContinuousBatcher:
         if all(r is None for r in self.rows):
             self._drain()  # trailing all-frozen segment, if any
             return
-        if bool(self.frozen.all()):
+        if bool(self.frozen.all()) and not self._lanes:
             # Only reserved (pending-admission) rows exist — nothing to
             # decode yet; the pending prefill advanced above. (The mirror
             # only lags toward MORE-frozen, so mirror-all-frozen implies
-            # the device carry is all-frozen too.)
+            # the device carry is all-frozen too.) With live piggyback
+            # lanes we fall through instead: the mixed dispatch advances
+            # them even though the decode half no-ops — the starvation
+            # guard that keeps lanes draining when nothing is decoding.
             self._drain()
             return
         chunk = self.chunk
@@ -1881,6 +2239,14 @@ class ContinuousBatcher:
             p, self._pending = self._pending, None
             self.rows[p.row] = None
             self._finish_forced(p.req, STATUS_DEADLINE)
+        for l in [x for x in self._lanes if expired(x.req)]:
+            # A piggybacked admission expired mid-prefill: drop the lane
+            # (its slot's KV is dead storage) and free the reserved row.
+            # No drain needed — the lane never touched the decode carry.
+            self._lanes.remove(l)
+            self._lane_free.append(l.slot)
+            self.rows[l.row] = None
+            self._finish_forced(l.req, STATUS_DEADLINE)
         for r, req in enumerate(self.rows):
             if req is not None and not self.frozen[r] and expired(req):
                 # A deadline can cross between step()'s drain check and
@@ -1893,7 +2259,8 @@ class ContinuousBatcher:
 
     def _dispatch_segment(self, chunk: Optional[int] = None, carry=None,
                           record_carry: bool = True,
-                          probe_faults: bool = True) -> dict:
+                          probe_faults: bool = True,
+                          warm_mixed: bool = False) -> dict:
         """Dispatch one decode/spec segment on the resident state WITHOUT
         waiting for it, and advance the device-resident carry. Returns the
         in-flight record ``_harvest_segment`` consumes — every entry a
@@ -1908,7 +2275,18 @@ class ContinuousBatcher:
         segment purely to compile/cache the executable (the while_loop
         exits at entry). ``probe_faults=False`` also skips the
         ``serve.dispatch`` fault site there, so armed chaos plans count
-        only scheduler dispatches."""
+        only scheduler dispatches. ``warm_mixed`` forces the MIXED
+        executable with idle lanes (warmup's compile of the piggyback
+        path).
+
+        With live piggyback lanes (ISSUE 5) the dispatch is a MIXED
+        segment: the same decode/spec body plus every lane advancing
+        ``chunk_p`` prompt positions, one executable, one dispatch — the
+        in-flight rows commit tokens at every admission boundary. The
+        ``serve.mixed_dispatch`` fault site fires at the lane-advance
+        boundary; a fault there degrades THIS boundary to a plain decode
+        dispatch with every lane re-queued (``_requeue_lanes``): the
+        admitting requests re-admit later, the decode rows never notice."""
         if chunk is None:
             chunk = self.chunk
         if probe_faults:
@@ -1932,6 +2310,22 @@ class ContinuousBatcher:
                 frozen, n_rem, base_pos = self._serving.place_carry(
                     self.mesh, self.max_batch, frozen, n_rem, base_pos
                 )
+        mixed = (warm_mixed or bool(self._lanes)) \
+            and self._lane_cache is not None
+        if mixed and self._lanes:
+            try:
+                # The lane-advance boundary is its own fault site: a
+                # fault HERE lands with admissions mid-prefill riding
+                # the decode dispatch — the lane-degradation handler
+                # must re-queue them without touching decode rows.
+                faults.maybe_fail("serve.mixed_dispatch")
+                faults.maybe_delay("serve.mixed_dispatch")
+            except Exception:
+                self._requeue_lanes()
+                mixed = False
+        if mixed:
+            (lane_start, lane_new_len, lane_last_idx, lane_adv,
+             lane_tok) = self._lane_args()
         rec = {"chunk": chunk, "frozen_in": frozen,
                "wait_at_dispatch": self.device_segment_s}
         if record_carry:
@@ -1944,6 +2338,7 @@ class ContinuousBatcher:
         t_disp0 = time.perf_counter()
         _ann = obs_profiling.annotation("serve.segment_dispatch")
         _ann.__enter__()
+        lane_out = None
         if self.speculative:
             n_iters = max(1, chunk // self.speculative)
             history = (jnp.asarray(self._history.astype(np.int32))
@@ -1951,19 +2346,56 @@ class ContinuousBatcher:
             if self.mesh is not None:
                 if history is not None:
                     history = self._serving.replicate(history, self.mesh)
-                fn = _get_sharded_spec_segment(
-                    self.cfg, n_iters, self.speculative, int(self.eos),
-                    self.temperature, self.top_p,
-                    self._cache_flat_sh, self._cache_treedef,
-                    self._ids_sh, self._b_sh, self._key_sh,
-                    self._drafts_sh,
-                )
+                if mixed:
+                    last_sh, hidden_sh = self._suffix_wave_sh(self._lane_cap)
+                    fn = _get_sharded_mixed_spec_segment(
+                        self.cfg, n_iters, self.speculative,
+                        self._lane_chunk, int(self.eos),
+                        self.temperature, self.top_p,
+                        self._cache_flat_sh, self._cache_treedef,
+                        self._ids_sh, self._b_sh, self._key_sh,
+                        self._drafts_sh, self._lane_flat_sh,
+                        self._lane_treedef, self._lane_emb_sh,
+                        last_sh, hidden_sh,
+                    )
+                    (self.ids_buf, n_new, done, self.cache, self.key,
+                     self.spec_drafts, it, frozen_out, n_rem_out,
+                     base_pos_out, *lane_out) = fn(
+                        self.params, self.cache, self.key, self.ids_buf,
+                        base_pos, frozen, n_rem, history, self.draft_head,
+                        self.spec_drafts, self._lane_embeds,
+                        self._lane_cache, lane_start, lane_new_len,
+                        lane_last_idx,
+                    )
+                else:
+                    fn = _get_sharded_spec_segment(
+                        self.cfg, n_iters, self.speculative, int(self.eos),
+                        self.temperature, self.top_p,
+                        self._cache_flat_sh, self._cache_treedef,
+                        self._ids_sh, self._b_sh, self._key_sh,
+                        self._drafts_sh,
+                    )
+                    (self.ids_buf, n_new, done, self.cache, self.key,
+                     self.spec_drafts, it, frozen_out, n_rem_out,
+                     base_pos_out) = fn(
+                        self.params, self.cache, self.key, self.ids_buf,
+                        base_pos, frozen, n_rem, history, self.draft_head,
+                        self.spec_drafts,
+                    )
+            elif mixed:
                 (self.ids_buf, n_new, done, self.cache, self.key,
                  self.spec_drafts, it, frozen_out, n_rem_out,
-                 base_pos_out) = fn(
-                    self.params, self.cache, self.key, self.ids_buf,
-                    base_pos, frozen, n_rem, history, self.draft_head,
-                    self.spec_drafts,
+                 base_pos_out, *lane_out) = (
+                    _mixed_spec_segment_jit(
+                        self.params, self.cfg, self.cache, self.key,
+                        self.ids_buf, base_pos, frozen, n_rem,
+                        self._lane_embeds, self._lane_cache, lane_start,
+                        lane_new_len, lane_last_idx, n_iters,
+                        self.speculative, self._lane_chunk,
+                        int(self.eos), self.temperature, self.top_p,
+                        history=history, medusa=self.draft_head,
+                        drafts=self.spec_drafts,
+                    )
                 )
             else:
                 (self.ids_buf, n_new, done, self.cache, self.key,
@@ -1990,16 +2422,48 @@ class ContinuousBatcher:
             )
         else:
             if self.mesh is not None:
-                fn = _get_sharded_decode_segment(
-                    self.cfg, chunk, int(self.eos),
-                    self.temperature, self.top_p, self.nan_check,
-                    self._cache_flat_sh, self._cache_treedef,
-                    self._logits_sh, self._toks_sh, self._b_sh, self._key_sh,
-                )
+                if mixed:
+                    last_sh, hidden_sh = self._suffix_wave_sh(self._lane_cap)
+                    fn = _get_sharded_mixed_decode_segment(
+                        self.cfg, chunk, self._lane_chunk, int(self.eos),
+                        self.temperature, self.top_p, self.nan_check,
+                        self._cache_flat_sh, self._cache_treedef,
+                        self._logits_sh, self._toks_sh, self._b_sh,
+                        self._key_sh, self._lane_flat_sh,
+                        self._lane_treedef, self._lane_emb_sh,
+                        last_sh, hidden_sh,
+                    )
+                    (tokens, n_new, done, fin, self.logits, self.cache,
+                     self.key, frozen_out, n_rem_out, *lane_out) = fn(
+                        self.params, self.logits, self.cache, self.key,
+                        frozen, n_rem, self._lane_embeds,
+                        self._lane_cache, lane_start, lane_new_len,
+                        lane_last_idx,
+                    )
+                else:
+                    fn = _get_sharded_decode_segment(
+                        self.cfg, chunk, int(self.eos),
+                        self.temperature, self.top_p, self.nan_check,
+                        self._cache_flat_sh, self._cache_treedef,
+                        self._logits_sh, self._toks_sh, self._b_sh,
+                        self._key_sh,
+                    )
+                    (tokens, n_new, done, fin, self.logits, self.cache,
+                     self.key, frozen_out, n_rem_out) = fn(
+                        self.params, self.logits, self.cache, self.key,
+                        frozen, n_rem,
+                    )
+            elif mixed:
                 (tokens, n_new, done, fin, self.logits, self.cache,
-                 self.key, frozen_out, n_rem_out) = fn(
-                    self.params, self.logits, self.cache, self.key,
-                    frozen, n_rem,
+                 self.key, frozen_out, n_rem_out, *lane_out) = (
+                    _mixed_decode_segment_jit(
+                        self.params, self.cfg, self.logits, self.cache,
+                        self.key, frozen, n_rem, self._lane_embeds,
+                        self._lane_cache, lane_start, lane_new_len,
+                        lane_last_idx, chunk, self._lane_chunk,
+                        int(self.eos), self.temperature, self.top_p,
+                        self.nan_check,
+                    )
                 )
             else:
                 (tokens, n_new, done, fin, self.logits, self.cache,
@@ -2012,6 +2476,26 @@ class ContinuousBatcher:
                 )
             base_pos_out = None
             rec.update(tokens=tokens, n_new=n_new, done=done, fin=fin)
+        if lane_out is not None:
+            # Lane bookkeeping happens at DISPATCH (not harvest): the
+            # advance is deterministic, so the pipelined scheduler can
+            # build the NEXT boundary's lane args before this segment's
+            # outputs are fetched. A lane that just covered its prompt
+            # keeps its final-chunk logits/hidden as futures — sliced and
+            # fetched only when the (drained) finish path runs.
+            lane_last, lane_hidden, self._lane_cache = lane_out
+            for l, end in lane_adv:
+                l.filled = end
+                if l.filled >= l.prompt_len:
+                    l.last_logits = lane_last[l.slot: l.slot + 1]
+                    l.last_hidden = lane_hidden[l.slot: l.slot + 1]
+            if record_carry and lane_adv:
+                self.mixed_prefill_tokens += lane_tok
+                obs_metrics.SERVE_MIXED_SEGMENTS.inc()
+                obs_metrics.SERVE_MIXED_LANES.observe(len(lane_adv))
+                obs_metrics.SERVE_MIXED_PREFILL_TOKENS.inc(lane_tok)
+                obs_metrics.SERVE_PREFILL_DISPATCHES.inc(kind="piggyback")
+                rec["n_lanes"] = len(lane_adv)
         if record_carry:
             self._dev_carry = (frozen_out, n_rem_out, base_pos_out)
             self.seg_count += 1
@@ -2080,6 +2564,17 @@ class ContinuousBatcher:
         n_new = np.asarray(n_new)
         done = np.asarray(done)
         frozen_in = np.asarray(frozen_in)
+        if rec.get("n_lanes"):
+            # Stall-free evidence (ISSUE 5): this segment carried live
+            # piggyback lanes. If decode rows were live too, they must
+            # have committed tokens in the SAME dispatch — a zero-token
+            # harvest here would be exactly the stall class the mixed
+            # segment exists to remove.
+            live = ~frozen_in
+            if live.any():
+                self.mixed_boundaries += 1
+                if int(n_new[live].sum()) == 0:
+                    self.mixed_zero_harvests += 1
         now = time.perf_counter()
         for r, req in enumerate(self.rows):
             # frozen_in is the segment's INPUT freeze mask (the host
@@ -2206,30 +2701,240 @@ class ContinuousBatcher:
             self._history[:-len(arr)] = self._history[len(arr):]
             self._history[-len(arr):] = arr
 
+    # -- stall-free admission lanes (ISSUE 5) -----------------------------
+
+    def _ensure_lane_buffers(self, s1: int) -> None:
+        """Allocate (or grow to bucket ``s1``) the resident lane buffers:
+        a (K_cap, S_lane) KV cache and a (K_cap, S_lane, D) prompt-embed
+        buffer. Growth pads the position axis, preserving live lanes'
+        state; each distinct S_lane compiles its own mixed executable, so
+        buckets stay at the prompt grain (rare growth, bounded
+        executables). Safe with a segment in flight: the pads enqueue on
+        the donated buffers' output futures."""
+        grain = 2 * SEQ_BUCKET
+        s1 = min(((s1 + grain - 1) // grain) * grain, self.max_len)
+        if self._lane_cache is not None and s1 <= self._lane_bucket:
+            return
+        d = self.cfg.llama.hidden_size
+        if self._lane_cache is None:
+            # ALWAYS unquantized, even on an int8-KV server: the lane's
+            # attention must read the same full-precision K/V one-shot
+            # prefill reads; quantization happens once, at finish
+            # (_lane_extract) — exactly where prefill's write does.
+            self._lane_cache = llama_mod.init_kv_cache(
+                self.cfg.llama, self._lane_cap, s1, dtype=self._dtype,
+                quant=False)
+            self._lane_embeds = jnp.zeros(
+                (self._lane_cap, s1, d), self._dtype)
+        else:
+            pad = s1 - self._lane_bucket
+
+            def grow(buf):
+                if isinstance(buf, dict):
+                    return {"q": grow(buf["q"]), "s": grow(buf["s"])}
+                return jnp.pad(buf, ((0, 0), (0, 0), (0, pad))
+                               + ((0, 0),) * (buf.ndim - 3))
+
+            self._lane_cache = {
+                "k": grow(self._lane_cache["k"]),
+                "v": grow(self._lane_cache["v"]),
+                "length": self._lane_cache["length"],
+            }
+            self._lane_embeds = jnp.pad(
+                self._lane_embeds, ((0, 0), (0, pad), (0, 0)))
+        self._lane_bucket = s1
+        if self.mesh is not None:
+            self._lane_cache = self._serving.shard_kv_cache(
+                self._lane_cache, self.cfg.llama, self.mesh)
+            self._lane_embeds = self._serving.shard_batch_array(
+                self._lane_embeds, self.mesh)
+            lane_sh = jax.tree_util.tree_map(
+                lambda x: x.sharding, self._lane_cache)
+            flat, treedef = jax.tree_util.tree_flatten(lane_sh)
+            self._lane_flat_sh, self._lane_treedef = tuple(flat), treedef
+            self._lane_emb_sh = self._lane_embeds.sharding
+
+    def _start_full_lane(self, req: "_Request", row: int) -> None:
+        """Open a piggyback lane for a full-prefill admission: the whole
+        prompt's embeddings load into the lane slot; the mixed segments
+        advance it ``chunk_p`` positions per boundary from position 0."""
+        padded, _, prompt_len = self._prep_request(req)
+        self._ensure_lane_buffers(padded.shape[1])
+        slot = self._lane_free.pop()
+        emb = padded[0]
+        self._lane_embeds = self._lane_embeds.at[
+            slot, : emb.shape[0]].set(emb)
+        if self.mesh is not None:
+            self._lane_embeds = jax.device_put(
+                self._lane_embeds, self._lane_emb_sh)
+        self._lanes.append(_PendingLane(req, row, slot, prompt_len))
+
+    def _start_suffix_lane(self, req: "_Request", row: int,
+                           entry: _PrefixEntry, suffix_ids,
+                           fit: tuple) -> None:
+        """Open a piggyback lane for a prefix-cache hit: the entry's KV
+        block seeds the lane row at [0, entry.length) (the copy is the
+        lane's starting offset) and only the SUFFIX embeds load — the
+        lane advances from ``filled = entry.length``."""
+        suf_len, prompt_len, _, s1 = fit
+        self._prefix_cache.count_hit(entry)
+        # Same fault site as the exclusive suffix paths: the copy
+        # boundary, with a row reserved and an entry about to be read.
+        faults.maybe_fail("serve.prefix_copy")
+        faults.maybe_delay("serve.prefix_copy")
+        t0 = time.perf_counter()
+        self._ensure_lane_buffers(max(s1, entry.bucket))
+        slot = self._lane_free.pop()
+        slot_arr = jnp.asarray(slot, jnp.int32)
+        if self.mesh is not None:
+            seed = _get_sharded_lane_seed(
+                self._lane_flat_sh, self._lane_treedef)
+        else:
+            seed = _lane_seed_jit
+        self._lane_cache = seed(
+            self._lane_cache, slot_arr, entry.kv["k"], entry.kv["v"])
+        emb = self._suffix_embed(entry, req.pixel_values, suffix_ids,
+                                 suf_len, suf_len)
+        plen = entry.length
+        self._lane_embeds = self._lane_embeds.at[
+            slot, plen: plen + suf_len].set(emb[0])
+        if self.mesh is not None:
+            self._lane_embeds = jax.device_put(
+                self._lane_embeds, self._lane_emb_sh)
+        tr = obs_trace.active()
+        if tr is not None:
+            tr.complete("prefix_copy", t0, time.perf_counter(),
+                        cat="sched", args={"plen": plen, "suffix": suf_len,
+                                           "lane": slot})
+        self._lanes.append(_PendingLane(
+            req, row, slot, prompt_len, filled=plen, entry=entry))
+
+    def _lane_args(self) -> tuple:
+        """Per-boundary lane inputs for the mixed dispatch: (start,
+        new_len, last_idx) over all K_cap slots plus the list of
+        (lane, end) pairs this boundary actually advances and their
+        total real prompt tokens. Idle and already-finished slots run a
+        no-op chunk (start == new_len; garbage above the pinned length,
+        masked)."""
+        k = self._lane_cap
+        start = np.zeros((k,), np.int32)
+        new_len = np.zeros((k,), np.int32)
+        last_idx = np.zeros((k,), np.int32)
+        advancing: List[tuple] = []
+        n_tok = 0
+        for l in self._lanes:
+            start[l.slot] = l.filled
+            if l.filled >= l.prompt_len:
+                new_len[l.slot] = l.filled  # ready: pinned, no advance
+                continue
+            end = min(l.filled + self._lane_chunk, l.prompt_len)
+            new_len[l.slot] = end
+            last_idx[l.slot] = max(0, min(l.prompt_len - 1 - l.filled,
+                                          self._lane_chunk - 1))
+            advancing.append((l, end))
+            n_tok += end - l.filled
+        return (jnp.asarray(start), jnp.asarray(new_len),
+                jnp.asarray(last_idx), advancing, n_tok)
+
+    def _requeue_lanes(self) -> None:
+        """Lane-degradation handler (the ``serve.mixed_dispatch`` fault
+        path): every piggybacked admission goes back to the FRONT of the
+        queue (original order), its reserved row is released, and the
+        boundary degrades to a plain decode dispatch — decode rows are
+        untouched. Re-admission re-prefills from scratch through
+        whichever path the next boundary picks."""
+        for l in reversed(self._lanes):
+            self.rows[l.row] = None  # row stays frozen; lane KV is dead
+            self.queue.appendleft(l.req)
+        self._lanes = []
+        self._lane_free = list(range(self._lane_cap))
+        obs_metrics.SERVE_QUEUE_DEPTH.set(len(self.queue))
+
+    def _finish_ready_lanes(self) -> bool:
+        """Complete every lane whose prompt is fully prefilled: slice its
+        lane-cache row out and run the NORMAL admission tail
+        (``_finish_admission`` — NaN quarantine, insert-on-prefill,
+        shared-cache scatter, activation incl. Medusa seeding), so a
+        piggybacked admission is indistinguishable from an exclusive one
+        from the row's first decoded token onward. Callers guarantee the
+        pipeline is drained (activation rewrites the carry)."""
+        done = False
+        for l in [x for x in self._lanes if x.filled >= x.prompt_len]:
+            self._lanes.remove(l)
+            self._lane_free.append(l.slot)
+            done = True
+            pk = pv = None
+            plen = 0
+            if self.kv_quant and l.entry is not None:
+                pk, pv = l.entry.kv["k"], l.entry.kv["v"]
+                plen = l.entry.length
+            slot_arr = jnp.asarray(l.slot, jnp.int32)
+            if self.mesh is not None:
+                fn = _get_sharded_lane_extract(
+                    self._lane_bucket, self.kv_quant,
+                    self._serving.prefix_block_sharding(
+                        self.mesh, self.cfg.llama),
+                    plen,
+                )
+                k, v = fn(self._lane_cache["k"], self._lane_cache["v"],
+                          slot_arr, pk, pv)
+            else:
+                k, v = _lane_extract_jit(
+                    self._lane_cache["k"], self._lane_cache["v"],
+                    slot_arr, pk, pv, self._lane_bucket, self.kv_quant,
+                    plen,
+                )
+            row_cache = {"k": k, "v": v,
+                         "length": jnp.asarray([l.prompt_len], jnp.int32)}
+            self._finish_admission(
+                l.req, l.row, l.prompt_len, row_cache, l.last_logits,
+                l.last_hidden if self.draft_head is not None else None,
+                prefix_entry=l.entry,
+            )
+        return done
+
     def _admit(self) -> bool:
         """Returns True when this step did admission work (advanced a
         pending chunked prefill or popped the queue) — the telemetry
         gate for the admission-stall histogram.
 
-        Admission order per popped request: longest-prefix match against
-        the prefix-KV cache (suffix-only admission), else the chunked
-        path (when actives are decoding), else collected into this
-        step's FULL-PREFILL WAVE — every wave member runs in ONE batched
-        prefill dispatch (``_admit_wave``) instead of N sequential
-        batch-1 prefills."""
+        Admission policy per popped request (ISSUE 5): with a
+        ``prefill_budget`` armed AND rows actively decoding (or lanes
+        already live), the request becomes a PIGGYBACK LANE — prefix-KV
+        hits seed the lane with the entry's block, misses load the whole
+        prompt — advanced inside the decode dispatch itself, up to
+        ``K_cap`` lanes at a time (excess requests stay queued; decode
+        keeps flowing either way). Otherwise (nothing to stall, or
+        budget off): longest-prefix match against the prefix-KV cache
+        (suffix-only admission), else the chunked path (when actives are
+        decoding), else collected into this step's FULL-PREFILL WAVE —
+        every wave member runs in ONE batched prefill dispatch
+        (``_admit_wave``) instead of N sequential batch-1 prefills."""
         from eventgpt_tpu.models.eventchat import _prefill_jit, _prefill_sharded
 
         faults.maybe_fail("serve.admit")
         faults.maybe_delay("serve.admit")
         did_work = False
+        if self._lanes:
+            # step() drained the pipeline when any lane was ready, so
+            # the activations below apply against settled state.
+            did_work |= self._finish_ready_lanes()
         if self._pending is not None:
             did_work = True
             self._advance_pending()
+        # Piggyback is the per-boundary choice only while something is
+        # decoding (or lanes are mid-flight — join them); with every row
+        # frozen there is nothing to stall and the exclusive wave is the
+        # fastest path to completion.
+        piggy = (self.prefill_budget > 0
+                 and (bool(self._lanes) or not bool(self.frozen.all())))
         wave: List[tuple] = []  # (req, row) full-prefill admissions
         hits: List[tuple] = []  # (req, row, entry, suffix_ids, fit)
         while (self._pending is None and self.queue
                and any(self.rows[r] is None
                        for r in range(self.max_batch))):
+            if piggy and not self._lane_free:
+                break  # lanes at the token budget: the rest stay queued
             req = self.queue.popleft()
             did_work = True
             obs_metrics.SERVE_QUEUE_DEPTH.set(len(self.queue))
@@ -2259,10 +2964,17 @@ class ContinuousBatcher:
                 entry, suffix_ids = hit
                 fit = self._prefix_fit(entry, suffix_ids)
                 if fit is not None:
+                    if piggy:
+                        self._start_suffix_lane(req, row, entry,
+                                                suffix_ids, fit)
+                        continue
                     hits.append((req, row, entry, suffix_ids, fit))
                     continue
             if self._prefix_cache is not None:
                 self._prefix_cache.count_miss()
+            if piggy:
+                self._start_full_lane(req, row)
+                continue
             if self.prefill_chunk and not bool(self.frozen.all()):
                 # Active rows are decoding: chunked admission. The row is
                 # reserved (kept frozen) and ONE prefill chunk advances

@@ -23,6 +23,14 @@ Rule 3 — catalogue coverage (ISSUE 4 satellite): every registered
 ``egpt_*`` metric has a row in OBSERVABILITY.md (literal name mention).
 An operator hunting a dashboard number must find its meaning in the
 catalogue; a metric that ships undocumented "passes" silently forever.
+
+Rule 4 — fault-site test coverage (ISSUE 5 satellite): every
+``faults.maybe_fail``/``maybe_delay`` site name wired in the runtime
+tree (``eventgpt_tpu/``) appears, by literal name, in at least one
+chaos/faults test — a tests/ file that actually arms injection
+(``faults.configure(`` or ``EGPT_FAULTS``). A fault site nobody can
+reach from a test is exactly the dead handling code ``faults.py``
+exists to prevent.
 """
 
 from __future__ import annotations
@@ -48,6 +56,12 @@ METRIC_SCAN = ("eventgpt_tpu", "scripts", "bench.py")
 METRIC_NAME_RE = re.compile(r"^egpt_[a-z0-9_]+$")
 _REG_RE = re.compile(
     r"\.(?:counter|gauge|histogram)\(\s*['\"]([A-Za-z0-9_.:-]+)['\"]")
+# Rule 4: fault-probe call sites in the runtime tree (string-literal
+# site names only — the grammar faults.py documents).
+_FAULT_SITE_RE = re.compile(
+    r"maybe_(?:fail|delay)\(\s*['\"]([A-Za-z0-9_.]+)['\"]")
+# A tests/ file counts as a chaos/faults test iff it arms injection.
+_FAULT_TEST_RE = re.compile(r"faults\.configure\(|EGPT_FAULTS")
 
 
 def _is_hot(rel: str) -> bool:
@@ -118,7 +132,51 @@ def run_lint(root: str) -> List[str]:
         violations.append("no metric registrations found — the scan "
                           "pattern or tree layout changed under the lint")
     _check_catalogue(root, seen, violations)
+    _check_fault_coverage(root, violations)
     return violations
+
+
+def _check_fault_coverage(root: str, violations: List[str]) -> None:
+    """Rule 4: every wired fault site is reachable from a chaos/faults
+    test (its literal name appears in a tests/ file that arms
+    injection). The example spec in faults.py's own docstring names real
+    sites, which is fine — they must be covered anyway."""
+    sites: Dict[str, str] = {}
+    pkg = os.path.join(root, "eventgpt_tpu")
+    for dirpath, _, files in os.walk(pkg):
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, f)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path) as fh:
+                src = fh.read()
+            for m in _FAULT_SITE_RE.finditer(src):
+                sites.setdefault(
+                    m.group(1),
+                    f"{rel}:{src.count(chr(10), 0, m.start()) + 1}")
+    chaos_text = []
+    tests = os.path.join(root, "tests")
+    if os.path.isdir(tests):
+        for f in sorted(os.listdir(tests)):
+            if not f.endswith(".py"):
+                continue
+            with open(os.path.join(tests, f)) as fh:
+                src = fh.read()
+            if _FAULT_TEST_RE.search(src):
+                chaos_text.append(src)
+    blob = "\n".join(chaos_text)
+    if not sites:
+        if os.path.isdir(pkg):
+            violations.append("no fault sites found under eventgpt_tpu/ — "
+                              "the scan pattern changed under the lint")
+        return
+    for name, site in sorted(sites.items()):
+        if name not in blob:
+            violations.append(
+                f"{site}: fault site {name!r} is not exercised by any "
+                f"chaos/faults test (no tests/ file arming injection "
+                f"mentions it) — unreachable failure handling rots")
 
 
 def _check_catalogue(root: str, seen: Dict[str, str],

@@ -145,6 +145,90 @@ def test_step_fault_site_reaches_caller_and_recovers(tiny):
     assert srv.finish_status[rid] == "ok"
 
 
+def test_admit_fault_site_reaches_caller_and_recovers(tiny):
+    """The ``serve.admit`` site fires inside the admission pass: the
+    step raises, the queued request survives, and the next (clean) steps
+    admit and serve it."""
+    cfg, params = tiny
+    srv = _batcher(tiny, max_batch=1)
+    rid = srv.submit([1, -200, 5], _pv(cfg), 4)
+    faults.configure("serve.admit:n=1")
+    with pytest.raises(faults.InjectedFault, match="serve.admit"):
+        srv.step()
+    out = srv.run_until_drained()  # n= fires once; the retry admits
+    assert len(out[rid]) == 4 and srv.finish_status[rid] == "ok"
+
+
+def test_multiproc_launch_fault_site_fires_before_spawn():
+    """``multiproc.launch`` fires at the launcher's entry — before any
+    worker process spawns, so a chaos plan can exercise the launcher's
+    failure surface without burning a cross-rank timeout."""
+    from eventgpt_tpu.parallel.multiproc import launch_multiprocess_dryrun
+
+    faults.configure("multiproc.launch:n=1")
+    with pytest.raises(faults.InjectedFault, match="multiproc.launch"):
+        launch_multiprocess_dryrun(
+            n_processes=1, local_devices=8, mesh_shape=(2, 2, 2, 1))
+    assert faults.stats()["multiproc.launch"]["fires"] == 1
+
+
+def test_multiproc_worker_fault_site_fires_at_bootstrap():
+    """``multiproc.worker`` is the first probe in ``worker_main`` (the
+    spawn env propagates EGPT_FAULTS): armed, the bootstrap dies before
+    touching the environment or the backend — the failure mode the
+    launcher's round-robin poll must surface as that rank's crash."""
+    from eventgpt_tpu.parallel.multiproc import worker_main
+
+    faults.configure("multiproc.worker:n=1")
+    with pytest.raises(faults.InjectedFault, match="multiproc.worker"):
+        worker_main()
+    assert faults.stats()["multiproc.worker"]["fires"] == 1
+
+
+@pytest.mark.slow
+def test_train_step_fault_site_counts_micro_batches(tmp_path):
+    """``train.step`` probes every micro-batch boundary: an armed delay
+    rule trips once per micro-step (the chaos hook the trainer's
+    preemption/divergence drills hang off). Sample-gated like the other
+    trainer e2e tests."""
+    import json
+    import os
+
+    SAMPLE_DIR = "/root/reference/samples"
+    if not os.path.exists(os.path.join(SAMPLE_DIR, "sample1.npy")):
+        pytest.skip("reference sample not available")
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.train.args import (
+        DataArguments, ModelArguments, TrainingArguments,
+    )
+    from eventgpt_tpu.train.trainer import Trainer
+
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    entries = [
+        {"id": i, "event": "sample1.npy",
+         "conversations": [
+             {"from": "human", "value": "<event>\nDescribe the scene."},
+             {"from": "gpt", "value": f"Answer number {i}."},
+         ]}
+        for i in range(4)
+    ]
+    data = tmp_path / "qa.json"
+    data.write_text(json.dumps(entries))
+    targs = TrainingArguments(
+        output_dir=str(tmp_path / "out"), stage=1, max_steps=1,
+        per_device_train_batch_size=2, logging_steps=1, save_steps=-1,
+        bf16=False, mesh_data=1, mesh_fsdp=2,
+    )
+    tr = Trainer(cfg, params, load_tokenizer("byte"), ModelArguments(),
+                 DataArguments(data_path=str(data), event_folder=SAMPLE_DIR),
+                 targs)
+    faults.configure("train.step:delay=0.001")
+    tr.train()
+    st = faults.stats()["train.step"]
+    assert st["calls"] >= 1 and st["fires"] >= 1
+
+
 def test_bounded_queue_rejects_at_submit(tiny):
     cfg, params = tiny
     srv = _batcher(tiny, max_batch=1, max_queue=2)

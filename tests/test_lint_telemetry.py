@@ -44,6 +44,14 @@ def test_lint_catches_each_violation_class(tmp_path):
     (tmp_path / "OBSERVABILITY.md").write_text(
         "| `egpt_documented_metric` | gauge | — | covered |\n")
     (pkg / "doc.py").write_text('R.gauge("egpt_documented_metric", "x")\n')
+    # Fault sites (rule 4): one covered by a faults-arming test, one not.
+    (pkg / "faulty.py").write_text(
+        'faults.maybe_fail("covered.site")\n'
+        'faults.maybe_delay("orphan.site")\n')
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "test_chaos.py").write_text(
+        'faults.configure("covered.site:n=1")\n')
     v = lint.run_lint(str(tmp_path))
     assert any("time.time()" in s for s in v)
     assert any("from time import time" in s for s in v)
@@ -51,6 +59,8 @@ def test_lint_catches_each_violation_class(tmp_path):
     assert any("registered twice" in s for s in v)
     assert any("'egpt_ok_metric' has no catalogue row" in s for s in v)
     assert not any("egpt_documented_metric" in s for s in v)
+    assert any("'orphan.site' is not exercised" in s for s in v)
+    assert not any("covered.site" in s for s in v)
 
 
 def test_lint_fails_closed_when_nothing_found(tmp_path):

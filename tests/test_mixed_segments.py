@@ -1,0 +1,291 @@
+"""Stall-free admission: mixed prefill+decode segments (ISSUE 5).
+
+The exactness matrix that makes the piggyback-lane scheduler shippable:
+with admissions folded INTO the decode dispatch (``prefill_budget``),
+every configuration must commit chains byte-identical to the exclusive
+admission paths (``prefill_budget=0``) AND to one-shot generate —
+scheduling is the only thing the mixed segment may change. Fast tier:
+tiny config, CPU f32, the traffic shape that actually exercises lanes
+(a long-lived decoding row + late admissions joining mid-flight).
+
+Plus the ISSUE 5 chaos case: a ``serve.mixed_dispatch`` fault mid-mixed-
+segment drains cleanly — the admitting lanes re-queue and re-admit, the
+decode rows never notice — and the stall-free property itself: in-flight
+rows commit tokens at every boundary a lane is advancing
+(``mixed_zero_harvests == 0``).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventgpt_tpu import faults
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.models import eventchat
+from eventgpt_tpu.serve import ContinuousBatcher
+
+EOS = 2
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.disable()
+    yield
+    faults.disable()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(5))
+    return cfg, params
+
+
+def _pv(cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(cfg.num_event_frames, 3, cfg.vision.image_size,
+                            cfg.vision.image_size)).astype(np.float32)
+
+
+def _oneshot(params, cfg, ids, pv, budget, **kw):
+    return eventchat.generate(
+        params, cfg, [ids], jnp.asarray(pv)[None], max_new_tokens=budget,
+        temperature=0.0, eos_token_id=None, **kw,
+    )[0]
+
+
+# The lane-exercising traffic: request A holds a row and decodes for the
+# whole window; B finishes fast (frees a row mid-flight); C is a
+# session-0 repeat (prefix-cache hit -> SUFFIX lane, seeded from the
+# entry); D is a fresh head (miss -> FULL lane). C and D arrive while A
+# is mid-decode, so with a budget armed they ride piggyback lanes.
+def _run(params, cfg, budget, **kw):
+    srv = ContinuousBatcher(
+        params, cfg, max_batch=2, max_len=256, chunk=4, eos_token_id=None,
+        prefill_budget=budget, prefill_lane_chunk=4, **kw,
+    )
+    reqs = [([1, 5, -200, 9, 9], _pv(cfg, 0), 30),
+            ([1, -200, 7, 7], _pv(cfg, 1), 5)]
+    rids = [srv.submit(i, p, b) for i, p, b in reqs]
+    srv.step()
+    srv.step()
+    late = [([1, 5, -200, 3], _pv(cfg, 0), 8),     # hit -> suffix lane
+            ([2, 6, -200, 11], _pv(cfg, 3), 7)]    # miss -> full lane
+    rids += [srv.submit(i, p, b) for i, p, b in late]
+    out = srv.run_until_drained()
+    return [out[r] for r in rids], reqs + late, srv
+
+
+_CONFIGS = {
+    "greedy": (dict(), dict()),
+    "int8_kv": (dict(kv_quant=True), dict(kv_quant=True)),
+    "speculative": (dict(speculative=4), dict()),
+    "spec_int8_kv": (dict(speculative=4, kv_quant=True),
+                     dict(kv_quant=True)),
+    "ttft_ramp": (dict(first_chunk=2), dict()),
+    "sync": (dict(pipeline=False), dict()),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_CONFIGS))
+def test_mixed_equals_exclusive_and_oneshot(tiny, name):
+    """ISSUE 5 exactness contract: mixed-segment chains byte-identical
+    to the exclusive-prefill scheduler and to one-shot generate, per
+    configuration. The mixed run must actually have used lanes
+    (piggybacked prompt tokens > 0) or the matrix proves nothing."""
+    cfg, params = tiny
+    kw, gkw = _CONFIGS[name]
+    mixed, reqs, srv = _run(params, cfg, budget=16, **kw)
+    exclusive, _, _ = _run(params, cfg, budget=0, **kw)
+    assert mixed == exclusive, name
+    for got, (ids, pv, budget) in zip(mixed, reqs):
+        assert got == _oneshot(params, cfg, ids, pv, budget, **gkw), name
+    assert srv.mixed_prefill_tokens > 0, name
+    assert srv.mixed_zero_harvests == 0, name
+
+
+def test_mixed_medusa_draft_head(tiny):
+    """Trained-head drafting rides the lane finish (the final chunk's
+    hidden seeds the draft window) — exactness must hold."""
+    cfg, params = tiny
+    heads = {"w": jax.random.normal(jax.random.PRNGKey(3),
+                                    (3, cfg.llama.hidden_size,
+                                     cfg.llama.hidden_size)) * 0.5}
+    kw = dict(speculative=4, draft_head=heads)
+    mixed, reqs, srv = _run(params, cfg, budget=16, **kw)
+    exclusive, _, _ = _run(params, cfg, budget=0, **kw)
+    assert mixed == exclusive
+    for got, (ids, pv, budget) in zip(mixed, reqs):
+        assert got == _oneshot(params, cfg, ids, pv, budget)
+    assert srv.mixed_prefill_tokens > 0
+
+
+def test_mixed_stall_free_property(tiny):
+    """The acceptance property itself: at every boundary where a lane
+    advanced alongside live decode rows, those rows committed tokens —
+    zero-token harvests while a prefill is in flight do not exist on the
+    mixed path."""
+    cfg, params = tiny
+    _, _, srv = _run(params, cfg, budget=16)
+    assert srv.mixed_boundaries > 0
+    assert srv.mixed_zero_harvests == 0
+    # And the budget was honoured: the lane fleet is capped at
+    # prefill_budget // chunk_p, bounded by the batch (a lane needs a
+    # reservable row).
+    assert srv._lane_cap == 2  # min(16 // 4, max_batch=2)
+    assert len(srv._lanes) == 0  # drained
+
+
+def test_mixed_budget_caps_concurrent_lanes(tiny):
+    """More admissions than the token budget allows lanes: the excess
+    stays queued (decode keeps flowing) and admits at later boundaries —
+    never more than ``prefill_budget // chunk_p`` lanes at once."""
+    cfg, params = tiny
+    srv = ContinuousBatcher(
+        params, cfg, max_batch=4, max_len=256, chunk=4, eos_token_id=None,
+        prefill_budget=4, prefill_lane_chunk=4,  # exactly ONE lane
+    )
+    a = srv.submit([1, 5, -200, 9, 9], _pv(cfg, 0), 30)
+    srv.step()
+    srv.step()
+    late = [srv.submit([1, 5, -200, i], _pv(cfg, 0), 6) for i in (3, 4, 12)]
+    max_lanes = 0
+    while srv.queue or any(r is not None for r in srv.rows):
+        srv.step()
+        max_lanes = max(max_lanes, len(srv._lanes))
+    srv._drain()
+    out, srv.finished = srv.finished, {}
+    assert max_lanes == 1
+    assert out[a] == _oneshot(params, cfg, [1, 5, -200, 9, 9], _pv(cfg, 0), 30)
+    for rid, i in zip(late, (3, 4, 12)):
+        assert out[rid] == _oneshot(params, cfg, [1, 5, -200, i],
+                                    _pv(cfg, 0), 6)
+
+
+def test_mixed_warmup_and_chained_admissions(tiny):
+    """warmup() precompiles the mixed executables (idle lanes) and the
+    TTFT-ramp variant; chained lane admissions across recycled rows stay
+    exact."""
+    cfg, params = tiny
+    srv = ContinuousBatcher(
+        params, cfg, max_batch=2, max_len=256, chunk=4, eos_token_id=None,
+        prefill_budget=16, prefill_lane_chunk=4, first_chunk=2,
+    )
+    n = srv.warmup(prompt_lens=[14])
+    assert n >= 6  # encode + prefill + admit + 2 segments + 2 mixed
+    a = srv.submit([1, 5, -200, 9, 9], _pv(cfg, 0), 20)
+    srv.step()
+    srv.step()
+    b = srv.submit([1, 5, -200, 3], _pv(cfg, 0), 8)
+    out = srv.run_until_drained()
+    assert out[a] == _oneshot(params, cfg, [1, 5, -200, 9, 9], _pv(cfg, 0), 20)
+    assert out[b] == _oneshot(params, cfg, [1, 5, -200, 3], _pv(cfg, 0), 8)
+
+
+def test_mixed_lane_deadline_and_cancel(tiny):
+    """Forced finishes hit lanes mid-prefill: the lane drops, its row
+    frees, the request finishes with the forced status and no tokens —
+    and the co-resident decode row's chain is untouched."""
+    cfg, params = tiny
+    srv = ContinuousBatcher(
+        params, cfg, max_batch=3, max_len=256, chunk=4, eos_token_id=None,
+        prefill_budget=32, prefill_lane_chunk=4,
+    )
+    a = srv.submit([1, 5, -200, 9, 9], _pv(cfg, 0), 40)
+    srv.step()
+    srv.step()
+    doomed = srv.submit([1, -200, 7, 7], _pv(cfg, 1), 8, deadline_s=60.0)
+    cancel_me = srv.submit([2, 6, -200, 11], _pv(cfg, 2), 8)
+    srv.step()  # lanes join (and advance once)
+    lane = next(l for l in srv._lanes if l.req.rid == doomed)
+    lane.req.deadline = time.perf_counter() - 1.0
+    assert srv.cancel(cancel_me)
+    out = srv.run_until_drained()
+    assert srv.finish_status[doomed] == "deadline_exceeded"
+    assert srv.finish_status[cancel_me] == "cancelled"
+    assert out[doomed] == [] and out[cancel_me] == []
+    assert out[a] == _oneshot(params, cfg, [1, 5, -200, 9, 9], _pv(cfg, 0), 40)
+
+
+def test_mixed_dispatch_fault_requeues_lanes_decode_unaffected(tiny):
+    """ISSUE 5 chaos: the ``serve.mixed_dispatch`` site fires at the
+    lane-advance boundary with admissions mid-prefill. The batcher's
+    lane-degradation handler must re-queue the admitting requests (front
+    of queue, original order), degrade that boundary to a plain decode
+    dispatch, and leave the decode rows' chains byte-identical — the
+    requeued requests then re-admit and finish exactly."""
+    cfg, params = tiny
+    faults.configure("serve.mixed_dispatch:n=1")
+    srv = ContinuousBatcher(
+        params, cfg, max_batch=2, max_len=256, chunk=4, eos_token_id=None,
+        prefill_budget=16, prefill_lane_chunk=4,
+    )
+    a = srv.submit([1, 5, -200, 9, 9], _pv(cfg, 0), 24)
+    srv.step()
+    srv.step()
+    c = srv.submit([1, 5, -200, 3], _pv(cfg, 0), 8)
+    out = srv.run_until_drained()
+    st = faults.stats()["serve.mixed_dispatch"]
+    assert st["fires"] == 1
+    assert out[a] == _oneshot(params, cfg, [1, 5, -200, 9, 9],
+                              _pv(cfg, 0), 24), "decode row unaffected"
+    assert out[c] == _oneshot(params, cfg, [1, 5, -200, 3],
+                              _pv(cfg, 0), 8), "requeued lane completes"
+    assert srv.finish_status[a] == "ok" and srv.finish_status[c] == "ok"
+    assert not srv._lanes and len(srv._lane_free) == srv._lane_cap
+
+
+def test_mixed_dispatch_fault_streak_still_serves(tiny):
+    """Every mixed boundary faulting (every=1): the scheduler degrades
+    each one to exclusive admission and still serves every request
+    exactly — graceful degradation, not an outage."""
+    cfg, params = tiny
+    faults.configure("serve.mixed_dispatch:every=1")
+    srv = ContinuousBatcher(
+        params, cfg, max_batch=2, max_len=256, chunk=4, eos_token_id=None,
+        prefill_budget=16, prefill_lane_chunk=4,
+    )
+    a = srv.submit([1, 5, -200, 9, 9], _pv(cfg, 0), 20)
+    srv.step()
+    srv.step()
+    c = srv.submit([2, 6, -200, 11], _pv(cfg, 3), 7)
+    out = srv.run_until_drained()
+    assert out[a] == _oneshot(params, cfg, [1, 5, -200, 9, 9], _pv(cfg, 0), 20)
+    assert out[c] == _oneshot(params, cfg, [2, 6, -200, 11], _pv(cfg, 3), 7)
+
+
+def test_mixed_sharded_dryrun(tiny):
+    """ISSUE 5 sharded leg: the mixed executables with pinned lane
+    shardings (``_get_sharded_mixed_*``, ``_get_sharded_lane_seed``,
+    ``_get_sharded_lane_extract``) compose with the serving mesh — lane
+    chains byte-identical to the single-chip mixed server and one-shot
+    generate, greedy and speculative."""
+    from eventgpt_tpu.config import MeshConfig
+    from eventgpt_tpu.parallel import make_mesh
+    from eventgpt_tpu.parallel.serving import shard_params_for_serving
+
+    cfg, params = tiny
+    mesh = make_mesh(MeshConfig(data=2, fsdp=2, context=1, model=2))
+    sharded = shard_params_for_serving(params, cfg, mesh)
+    for kw in (dict(), dict(speculative=4)):
+        srv = ContinuousBatcher(
+            sharded, cfg, mesh=mesh, max_batch=2, max_len=256, chunk=4,
+            eos_token_id=None, prefill_budget=16, prefill_lane_chunk=4,
+            **kw,
+        )
+        a = srv.submit([1, 5, -200, 9, 9], _pv(cfg, 0), 20)
+        srv.step()
+        srv.step()
+        c = srv.submit([1, 5, -200, 3], _pv(cfg, 0), 8)   # suffix lane
+        d = srv.submit([2, 6, -200, 11], _pv(cfg, 3), 7)  # full lane
+        out = srv.run_until_drained()
+        assert out[a] == _oneshot(params, cfg, [1, 5, -200, 9, 9],
+                                  _pv(cfg, 0), 20), kw
+        assert out[c] == _oneshot(params, cfg, [1, 5, -200, 3],
+                                  _pv(cfg, 0), 8), kw
+        assert out[d] == _oneshot(params, cfg, [2, 6, -200, 11],
+                                  _pv(cfg, 3), 7), kw
+        assert srv.mixed_prefill_tokens > 0, kw

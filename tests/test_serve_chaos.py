@@ -109,6 +109,25 @@ def test_transient_fault_restarts_scheduler_and_recovers(tiny):
         eng.shutdown()
 
 
+def test_loop_fault_site_restarts_scheduler(tiny):
+    """The ``serve.loop`` site fires inside the engine's scheduler loop
+    (before the step): the engine survives it exactly like a step fault
+    — restart, clean service after."""
+    cfg, params = tiny
+    faults.configure("serve.loop:n=2")
+    eng = _engine(tiny, breaker_threshold=3, breaker_cooldown_s=0.5)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and eng.n_faults < 1:
+            time.sleep(0.02)  # idle loop iterations reach the site too
+        assert eng.n_faults >= 1
+        rid = eng.submit("still alive?", _pv(cfg), 4)
+        assert len(eng.result(rid, timeout=120)) == 4
+        assert faults.stats()["serve.loop"]["fires"] == 1
+    finally:
+        eng.shutdown()
+
+
 def test_breaker_trips_degrades_health_then_half_open_recovers(tiny):
     """The acceptance scenario: consecutive scheduler faults trip the
     breaker -> /health says degraded (503) and POSTs are refused -> the
