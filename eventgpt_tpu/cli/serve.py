@@ -144,7 +144,8 @@ class ServingEngine:
 
     def submit(self, query: str, pixels, max_new_tokens: int,
                stream: bool = False,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               slo=None) -> int:
         from eventgpt_tpu.data.conversation import prepare_event_prompt
         from eventgpt_tpu.data.tokenizer import tokenize_with_event
 
@@ -160,7 +161,7 @@ class ServingEngine:
             if self.breaker_open():
                 raise RuntimeError(f"serving engine is down: {self.fault}")
             rid = self.batcher.submit(ids, pixels, max_new_tokens,
-                                      deadline_s=deadline_s)
+                                      deadline_s=deadline_s, slo=slo)
             self._done[rid] = threading.Event()
             if stream:
                 self._streams[rid] = queue.Queue()
@@ -252,6 +253,9 @@ class ServingEngine:
             "lanes": len(getattr(b, "_lanes", ()) or ()),
             "overlap_ratio": round(b.overlap_ratio(), 3)
             if hasattr(b, "overlap_ratio") else 0.0,
+            # SLO classes + windowed goodput (ISSUE 6): per-class
+            # attainment so /stats carries the class alongside /metrics.
+            "slo": b.slo_stats() if hasattr(b, "slo_stats") else {},
             **({"spec_tokens_per_iteration":
                 round(b.spec_tokens_per_iteration(), 2)}
                if b.speculative else {}),
@@ -505,7 +509,20 @@ def _decode_pixels(payload: Dict[str, Any], cfg, event_root=None):
 def make_handler(engine: ServingEngine, cfg, event_root=None,
                  default_budget: int = 64,
                  max_body_bytes: int = 32 * 1024 * 1024,
-                 default_deadline_s: Optional[float] = None):
+                 default_deadline_s: Optional[float] = None,
+                 slo_classes: Optional[Dict[str, Any]] = None):
+    if slo_classes is None:
+        # Server-default SLO targets per class (ISSUE 6); build_server
+        # overrides from --slo_* flags. A payload "slo_class" picks one;
+        # optional payload slo_ttft_s / slo_itl_s / slo_latency_s
+        # override the targets for that request only.
+        from eventgpt_tpu.workload import SLO
+
+        slo_classes = {
+            "interactive": SLO("interactive", ttft_s=1.0, itl_s=0.25),
+            "batch": SLO("batch", latency_s=30.0),
+        }
+
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -667,6 +684,26 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                 budget = int(payload.get("max_new_tokens", default_budget))
                 deadline = payload.get("deadline_s", default_deadline_s)
                 deadline = float(deadline) if deadline else None
+                slo = None
+                if "slo_class" in payload:
+                    # Per-request SLO class (ISSUE 6): unknown names are
+                    # the client's fault — the class set is closed
+                    # (bounded metric-label cardinality).
+                    name = str(payload["slo_class"])
+                    if name not in slo_classes:
+                        raise ValueError(
+                            f"unknown slo_class {name!r}: one of "
+                            f"{sorted(slo_classes)}")
+                    slo = slo_classes[name]
+                    overrides = {
+                        k[4:]: float(payload[k])
+                        for k in ("slo_ttft_s", "slo_itl_s",
+                                  "slo_latency_s") if k in payload
+                    }
+                    if overrides:
+                        import dataclasses
+
+                        slo = dataclasses.replace(slo, **overrides)
                 pixels = _decode_pixels(payload, cfg, event_root)
             except Exception as e:  # bad request, not a server fault
                 self._json(400, {"error": str(e)})
@@ -675,7 +712,7 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
             t0 = time.perf_counter()
             try:
                 rid = engine.submit(query, pixels, budget, stream=stream,
-                                    deadline_s=deadline)
+                                    deadline_s=deadline, slo=slo)
             except QueueFullError as e:
                 # Backpressure, not failure: tell the client to come back
                 # (bounded admission queue — ISSUE 1 tentpole).
@@ -731,6 +768,10 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                         stats.get("latency_s",
                                   time.perf_counter() - t0), 3),
                 }
+                if slo is not None:
+                    obj["slo_class"] = slo.name
+                    if "slo_met" in stats:
+                        obj["slo_met"] = bool(stats["slo_met"])
                 # Forced finishes map to structured HTTP errors (the
                 # partial answer rides along): deadline -> 504,
                 # cancel -> 499 (client asked), NaN quarantine -> 500.
@@ -868,6 +909,7 @@ def build_server(args) -> tuple:
         prefill_budget=(args.chunk
                         if getattr(args, "prefill_budget", -1) < 0
                         else int(args.prefill_budget)),
+        slo_window=int(getattr(args, "slo_window", 256)),
     )
     if args.warmup:
         t0 = time.perf_counter()
@@ -896,13 +938,27 @@ def build_server(args) -> tuple:
         plen = engine.set_prefix(args.prefix_prompt, pixels)
         print(f"[serve] shared prefix cached: {plen} positions")
     default_deadline = getattr(args, "default_deadline_s", 0) or None
+    # Per-class SLO targets (ISSUE 6): a payload {"slo_class": ...}
+    # scores the request against these at finish (0 disarms a target).
+    from eventgpt_tpu.workload import SLO
+
+    slo_classes = {
+        "interactive": SLO(
+            "interactive",
+            ttft_s=getattr(args, "slo_interactive_ttft_s", 1.0) or None,
+            itl_s=getattr(args, "slo_interactive_itl_s", 0.25) or None),
+        "batch": SLO(
+            "batch",
+            latency_s=getattr(args, "slo_batch_latency_s", 30.0) or None),
+    }
     httpd = ThreadingHTTPServer(
         (args.host, args.port),
         make_handler(engine, cfg, getattr(args, "event_root", None),
                      default_budget=getattr(args, "max_new_tokens", 64),
                      max_body_bytes=int(
                          getattr(args, "max_body_mb", 32) * 1024 * 1024),
-                     default_deadline_s=default_deadline),
+                     default_deadline_s=default_deadline,
+                     slo_classes=slo_classes),
     )
     return httpd, engine
 
@@ -992,6 +1048,19 @@ def main(argv=None):
     p.add_argument("--heartbeat_dir", default=None,
                    help="directory for the serving heartbeat.json "
                         "(train/resilience.py format; unset = disabled)")
+    # -- SLO classes + goodput (ISSUE 6; OBSERVABILITY.md) --
+    p.add_argument("--slo_interactive_ttft_s", type=float, default=1.0,
+                   help="interactive-class TTFT target scored at finish "
+                        "(payload slo_class=interactive; 0 disarms)")
+    p.add_argument("--slo_interactive_itl_s", type=float, default=0.25,
+                   help="interactive-class mean inter-token-gap target "
+                        "(0 disarms)")
+    p.add_argument("--slo_batch_latency_s", type=float, default=30.0,
+                   help="batch-class end-to-end latency target "
+                        "(payload slo_class=batch; 0 disarms)")
+    p.add_argument("--slo_window", type=int, default=256,
+                   help="finished SLO-classed requests in the windowed "
+                        "goodput gauge egpt_serve_slo_goodput_ratio")
     # -- telemetry (ISSUE 3; OBSERVABILITY.md) --
     p.add_argument("--trace_buffer", type=int, default=65536,
                    help="request/step trace ring capacity in events "

@@ -44,6 +44,51 @@ NAME_RE = re.compile(r"^egpt_[a-z0-9_]+$")
 
 _INF = float("inf")
 
+# Fixed label-value enums per metric (lint rule 5, ISSUE 6 satellite):
+# every labelled observation in the runtime tree draws its values from
+# the set declared HERE — bounded cardinality by construction. A
+# request-shaped label (rid, user id, session id) would grow the
+# exposition without bound and is banned outright by
+# scripts/lint_telemetry.py. The lint enforces this statically (literal
+# values must be members, every label key must have an enum) and the
+# metric classes enforce it at observe time for the metrics listed
+# below; OBSERVABILITY.md's catalogue documents the same enums. This
+# dict is a PURE LITERAL on purpose — the lint reads it with
+# ast.literal_eval, no imports.
+METRIC_LABELS = {
+    "egpt_serve_requests_total": {
+        "status": ("ok", "deadline_exceeded", "cancelled",
+                   "nan_quarantined", "engine_fault"),
+    },
+    "egpt_serve_prefill_dispatches_total": {
+        "kind": ("full", "wave", "chunk", "suffix", "suffix_wave",
+                 "piggyback"),
+    },
+    "egpt_fault_trips_total": {
+        # Mirrors the wired maybe_fail/maybe_delay sites (lint rule 5
+        # cross-checks this tuple against rule 4's site scan, so a new
+        # site cannot ship without extending the enum); "other" absorbs
+        # synthetic/ad-hoc drill sites (faults._site_label clamps).
+        "site": ("multiproc.launch", "multiproc.worker", "serve.admit",
+                 "serve.dispatch", "serve.loop", "serve.mixed_dispatch",
+                 "serve.prefix_copy", "serve.step", "train.step", "other"),
+        "kind": ("fail", "delay"),
+    },
+    "egpt_serve_slo_requests_total": {
+        "slo_class": ("interactive", "batch"),
+        "met": ("true", "false"),
+    },
+    "egpt_serve_slo_ttft_seconds": {
+        "slo_class": ("interactive", "batch"),
+    },
+    "egpt_serve_slo_itl_seconds": {
+        "slo_class": ("interactive", "batch"),
+    },
+    "egpt_serve_slo_latency_seconds": {
+        "slo_class": ("interactive", "batch"),
+    },
+}
+
 
 def log2_buckets(lo: float, hi: float) -> Tuple[float, ...]:
     """Power-of-two upper bounds covering [lo, hi]: the first bound is
@@ -104,11 +149,24 @@ class _Metric:
         self.help = help
         self._reg = registry
         self._lock = threading.Lock()
+        # Declared label enums for THIS metric (None = unlisted, e.g. a
+        # test's private registry): observe-time backstop for the static
+        # lint — an out-of-enum value raises instead of minting a fresh
+        # unbounded series.
+        self._enums = METRIC_LABELS.get(name)
 
-    @staticmethod
-    def _key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    def _key(self, labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
         if not labels:
             return ()
+        if self._enums is not None:
+            for k, v in labels.items():
+                vals = self._enums.get(k)
+                if vals is None or str(v) not in vals:
+                    raise ValueError(
+                        f"metric {self.name}: label {k}={v!r} outside "
+                        f"the declared enum (METRIC_LABELS, "
+                        f"obs/metrics.py) — labels are bounded-"
+                        f"cardinality by contract (lint rule 5)")
         return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
 
@@ -444,6 +502,28 @@ SERVE_MIXED_PREFILL_TOKENS = REGISTRY.counter(
     "egpt_serve_mixed_prefill_tokens_total",
     "Prompt positions prefilled inside mixed segments (piggyback lanes), "
     "bounded per boundary by --prefill_budget")
+# -- SLO classes + goodput (ISSUE 6, eventgpt_tpu/serve.py) --
+SERVE_SLO_REQUESTS = REGISTRY.counter(
+    "egpt_serve_slo_requests_total",
+    "Finished SLO-classed requests by class and attainment (met=true "
+    "when every armed target held, inclusive)")
+SERVE_SLO_TTFT = REGISTRY.histogram(
+    "egpt_serve_slo_ttft_seconds",
+    "Submit to first committed token by SLO class (requests that never "
+    "committed are excluded, as in egpt_serve_ttft_seconds)")
+SERVE_SLO_ITL = REGISTRY.histogram(
+    "egpt_serve_slo_itl_seconds",
+    "Per-request mean inter-token gap by SLO class (first token "
+    "excluded - that interval is TTFT; single-token requests excluded)",
+    SHORT_BUCKETS)
+SERVE_SLO_LATENCY = REGISTRY.histogram(
+    "egpt_serve_slo_latency_seconds",
+    "Submit to terminal status by SLO class (every terminal path - "
+    "forced finishes stay in the goodput denominator)")
+SERVE_SLO_GOODPUT = REGISTRY.gauge(
+    "egpt_serve_slo_goodput_ratio",
+    "Fraction of the last slo_window SLO-classed finishes that met "
+    "their targets (windowed SLO-attainment goodput)")
 
 # -- fault injection (eventgpt_tpu/faults.py) --
 FAULT_TRIPS = REGISTRY.counter(

@@ -107,6 +107,7 @@ from eventgpt_tpu.obs import trace as obs_trace
 from eventgpt_tpu.constants import SEQ_BUCKET
 from eventgpt_tpu.models import eventchat, llama as llama_mod
 from eventgpt_tpu.ops.sampling import sample
+from eventgpt_tpu.workload import SLO, SLO_CLASSES
 
 
 class QueueFullError(RuntimeError):
@@ -1164,6 +1165,11 @@ class _Request:
     # cannot be LRU-evicted until the row finishes; _record_finish drains
     # it). None for full-prefill admissions.
     prefix_entry: Optional["_PrefixEntry"] = None
+    # Service-level objective (ISSUE 6): the class + targets this
+    # request is scored against at finish (workload.SLO; None = unscored
+    # — the pre-SLO behavior). Scoring reads clocks and host state only,
+    # so chains are byte-identical with or without an SLO attached.
+    slo: Optional[SLO] = None
 
 
 class ContinuousBatcher:
@@ -1209,6 +1215,7 @@ class ContinuousBatcher:
         prefix_insert: bool = True,
         prefill_budget: int = 0,
         prefill_lane_chunk: int = 0,
+        slo_window: int = 256,
     ):
         if prefill_chunk and (2 * SEQ_BUCKET) % prefill_chunk:
             # A chunk that does not divide the bucket grain would force
@@ -1399,6 +1406,10 @@ class ContinuousBatcher:
         # weight-streaming pass, so it exceeds the per-chain window bound
         # when several rows are active).
         self.request_stats: Dict[int, Dict[str, float]] = {}
+        # Windowed goodput (ISSUE 6): the last ``slo_window`` SLO-classed
+        # finishes, True per request that met every armed target — the
+        # egpt_serve_slo_goodput_ratio gauge is their mean.
+        self._slo_window_len = max(int(slo_window), 1)
         self.reset_serving_stats()
 
     def _init_mesh_placement(self, vocab: int) -> None:
@@ -1938,7 +1949,8 @@ class ContinuousBatcher:
 
     def submit(self, input_ids: Sequence[int], pixel_values,
                max_new_tokens: int = 64,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               slo: Optional[SLO] = None) -> int:
         """Enqueue one request; raises immediately if it cannot fit, so one
         oversized request never tears down the serving loop mid-drain.
 
@@ -1946,9 +1958,23 @@ class ContinuousBatcher:
         finished with ``STATUS_DEADLINE`` (whatever tokens it committed so
         far are returned) instead of holding a batch row for its full
         budget. Raises ``QueueFullError`` when the admission queue is at
-        ``max_queue`` (backpressure — the caller should retry later)."""
+        ``max_queue`` (backpressure — the caller should retry later).
+
+        ``slo``: the request's service-level objective (``workload.SLO``
+        — class name + TTFT/ITL/latency targets). Scored at finish
+        (``_record_finish``) into the ``egpt_serve_slo_*`` metrics and
+        ``slo_stats()``; purely observational — scheduling is unchanged
+        and chains stay byte-identical with or without it. The class
+        name must be one of ``SLO_CLASSES`` (it becomes a metric label;
+        bounded cardinality, lint rule 5)."""
         from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
 
+        if slo is not None and slo.name not in SLO_CLASSES:
+            raise ValueError(
+                f"unknown SLO class {slo.name!r}: one of {SLO_CLASSES} "
+                f"(class names are metric labels and must stay a closed "
+                f"set)"
+            )
         if self.max_queue and len(self.queue) >= self.max_queue:
             raise QueueFullError(
                 f"admission queue is full ({len(self.queue)}/"
@@ -1979,14 +2005,16 @@ class ContinuousBatcher:
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(rid, ids, pixel_values, max_new_tokens)
+        req.slo = slo
         req.t_submit = time.perf_counter()
         if deadline_s is not None:
             req.deadline = req.t_submit + float(deadline_s)
             self._n_deadlines += 1
         self.queue.append(req)
         obs_metrics.SERVE_QUEUE_DEPTH.set(len(self.queue))
-        obs_trace.async_begin("queued", rid,
-                              prompt_len=prompt_len, budget=max_new_tokens)
+        obs_trace.async_begin(
+            "queued", rid, prompt_len=prompt_len, budget=max_new_tokens,
+            **({"slo_class": slo.name} if slo is not None else {}))
         return rid
 
     def cancel(self, rid: int) -> bool:
@@ -2046,6 +2074,29 @@ class ContinuousBatcher:
         return {"enabled": True, "insert_on_prefill": self.prefix_insert,
                 **self._prefix_cache.stats()}
 
+    def slo_stats(self) -> Dict[str, Any]:
+        """SLO-attainment snapshot (ISSUE 6): per-class finished/met
+        counts + attainment ratio, and the windowed goodput ratio —
+        host-side counters, so the numbers exist with telemetry disarmed
+        (the `/stats` merge and the bench read them here; /metrics
+        exposes the same story as ``egpt_serve_slo_*``)."""
+        classes: Dict[str, Dict[str, Any]] = {}
+        for (name, met), n in sorted(self.slo_counts.items()):
+            c = classes.setdefault(name, {"finished": 0, "met": 0})
+            c["finished"] += n
+            if met:
+                c["met"] += n
+        for c in classes.values():
+            c["attainment"] = (c["met"] / c["finished"]
+                               if c["finished"] else 0.0)
+        w = len(self._slo_window)
+        return {
+            "classes": classes,
+            "window_n": w,
+            "window_size": self._slo_window_len,
+            "goodput_ratio": (sum(self._slo_window) / w) if w else 0.0,
+        }
+
     def spec_tokens_per_iteration(self) -> float:
         """Realized aggregate acceptance: committed tokens per verify
         iteration (= per weight-streaming pass, summed across batch rows
@@ -2090,6 +2141,12 @@ class ContinuousBatcher:
         self.mixed_boundaries = 0
         self.mixed_zero_harvests = 0
         self.mixed_prefill_tokens = 0
+        # SLO attainment (ISSUE 6), phase-scoped like everything above:
+        # (class, met) -> finished-request counts (host-side, so goodput
+        # is reportable with telemetry disarmed too, the prefix-cache
+        # counter convention), plus the windowed-goodput ring.
+        self.slo_counts: Dict[tuple, int] = {}
+        self._slo_window: deque = deque(maxlen=self._slo_window_len)
 
     def overlap_ratio(self) -> float:
         """Fraction of host scheduler work hidden behind device compute
@@ -2664,9 +2721,18 @@ class ContinuousBatcher:
         ttft = (req.t_first if req.t_first is not None
                 else req.t_done) - req.t_submit
         latency = req.t_done - req.t_submit
+        # Realized mean inter-token gap over the request (first token
+        # excluded — that interval is TTFT). Tokens land in harvest-sized
+        # groups, so this is the request-level mean of the same quantity
+        # the egpt_serve_itl_seconds histogram samples per harvest.
+        n_commit = len(req.tokens)
+        itl = ((req.t_last - req.t_first) / (n_commit - 1)
+               if (req.t_first is not None and req.t_last is not None
+                   and n_commit > 1) else 0.0)
         self.request_stats[req.rid] = {
             "ttft_s": ttft,
             "latency_s": latency,
+            "itl_s": itl,
         }
         if req.t_first is not None:
             # Forced finishes that never committed a token (expired in the
@@ -2675,11 +2741,40 @@ class ContinuousBatcher:
             obs_metrics.SERVE_TTFT.observe(ttft)
         obs_metrics.SERVE_LATENCY.observe(latency)
         obs_metrics.SERVE_REQUESTS.inc(status=status)
+        slo_met: Optional[bool] = None
+        if req.slo is not None:
+            # SLO attainment (ISSUE 6): score the request against its
+            # class targets on EVERY terminal path — a deadline-expired
+            # interactive request that never committed scores on its
+            # t_done stand-in TTFT, which is a miss whenever the target
+            # is tighter than the time already burned (Sarathi-style
+            # goodput counts completions within SLO, so forced finishes
+            # must not vanish from the denominator).
+            slo_met = req.slo.met(ttft, itl, latency)
+            key = (req.slo.name, slo_met)
+            self.slo_counts[key] = self.slo_counts.get(key, 0) + 1
+            self._slo_window.append(slo_met)
+            self.request_stats[req.rid]["slo_met"] = float(slo_met)
+            obs_metrics.SERVE_SLO_REQUESTS.inc(
+                slo_class=req.slo.name,
+                met="true" if slo_met else "false")
+            if req.t_first is not None:
+                obs_metrics.SERVE_SLO_TTFT.observe(
+                    ttft, slo_class=req.slo.name)
+            if n_commit > 1:
+                obs_metrics.SERVE_SLO_ITL.observe(
+                    itl, slo_class=req.slo.name)
+            obs_metrics.SERVE_SLO_LATENCY.observe(
+                latency, slo_class=req.slo.name)
+            obs_metrics.SERVE_SLO_GOODPUT.set(
+                sum(self._slo_window) / len(self._slo_window))
         obs_metrics.SERVE_ACTIVE_ROWS.set(
             sum(r is not None for r in self.rows))
         obs_metrics.SERVE_QUEUE_DEPTH.set(len(self.queue))
-        obs_trace.async_end(req.phase, req.rid, status=status,
-                            tokens=len(ids))
+        obs_trace.async_end(
+            req.phase, req.rid, status=status, tokens=len(ids),
+            **({"slo_class": req.slo.name, "slo_met": slo_met}
+               if req.slo is not None else {}))
         if status == STATUS_OK:
             self._history_append(ids)
         self.finished[req.rid] = ids

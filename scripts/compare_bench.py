@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Noise-aware regression gate for bench / workload JSON records.
+
+Usage:
+  python scripts/compare_bench.py BASE.json NEW.json [--tolerance 0.15]
+         [--abs_floor 0.002] [--require KEY ...]
+
+Diffs two bench records (``bench.py`` one-line records, the driver's
+``BENCH_r0N.json`` wrapper — ``{"parsed": {...}}`` — or ``bench.py
+--mode workload`` / ``WORKLOAD_r0N.json`` records) and exits non-zero
+when any shared performance key regressed beyond the tolerance. This is
+the hook later PRs cite instead of eyeballing numbers: "compare_bench
+r(N) vs r(N-1) is clean" is a checkable claim; "the numbers look fine"
+is not.
+
+Design points (all learned from the repo's own measurement history,
+PERFORMANCE.md):
+
+  * **Direction-aware.** tok/s, goodput, ratios, MFU are
+    higher-is-better; seconds/ms (TTFT, ITL, latency, stalls, step
+    time) are lower-is-better. Keys whose direction cannot be inferred
+    are reported as informational drift, never gated.
+  * **Drift tolerance.** CPU throughput drifts ±15% between machine
+    phases (the measured envelope; interleave A/B runs when a claim
+    needs better), so the default gate fires only beyond 15%. Tighten
+    with ``--tolerance`` for same-phase interleaved records.
+  * **Absolute floor.** Two sub-``--abs_floor`` timings (default 2 ms)
+    compare equal: at that scale the log2-bucket/scheduler jitter is
+    bigger than the signal, and 0.001 s -> 0.002 s is not a 2x
+    regression.
+  * **Paired sweep points.** ``"sweep"`` lists (workload records) match
+    pointwise by ``rate_mult``; ``"ab"`` interleaved arrays compare by
+    their means.
+
+Only the performance-shaped keys are gated (``_GATE_PATTERNS``); config
+echo keys (batch, chunk, seeds, counts) are identity context, not
+metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# Measured machine-phase drift envelope on CPU smoke runs
+# (PERFORMANCE.md): regressions inside it are indistinguishable from
+# noise in unpaired runs.
+DEFAULT_TOLERANCE = 0.15
+DEFAULT_ABS_FLOOR = 0.002  # seconds-scale values below this compare equal
+
+# Key substrings that mark a value as a gated performance metric, with
+# direction. Checked in order; first match wins.
+_HIGHER = ("tok_s", "tokens_per_s", "goodput", "attainment", "hit_ratio",
+           "met_ratio", "overlap_ratio", "mfu", "tokens_per_iteration",
+           "goodput_ratio")
+_LOWER = ("ttft", "itl", "latency", "stall", "step_s", "step_time", "_ms",
+          "wait", "duration_s", "first_request_s", "warmup_s", "_p50_s",
+          "_p99_s", "_p95_s", "overhead_frac")
+
+
+def direction(key: str) -> Optional[int]:
+    """+1 higher-is-better, -1 lower-is-better, None = not gated."""
+    k = key.lower()
+    for pat in _HIGHER:
+        if pat in k:
+            return +1
+    for pat in _LOWER:
+        if pat in k:
+            return -1
+    return None
+
+
+def _unwrap(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """BENCH_r0N.json driver wrapper -> the bench record inside it."""
+    if "parsed" in rec and isinstance(rec["parsed"], dict):
+        return rec["parsed"]
+    return rec
+
+
+def _mean(v: Any) -> Optional[float]:
+    if isinstance(v, bool):
+        return None
+    if isinstance(v, (int, float)):
+        return float(v)
+    if (isinstance(v, list) and v
+            and all(isinstance(x, (int, float)) and not isinstance(x, bool)
+                    for x in v)):
+        return sum(float(x) for x in v) / len(v)
+    return None
+
+
+def _flatten(rec: Dict[str, Any], prefix: str = "") -> Dict[str, float]:
+    """Numeric leaves (lists -> means: the interleaved-A/B form), keyed
+    by dotted path. ``sweep`` lists key by rate_mult so points pair."""
+    out: Dict[str, float] = {}
+    for k, v in rec.items():
+        path = f"{prefix}{k}"
+        if k == "sweep" and isinstance(v, list):
+            for leg in v:
+                if isinstance(leg, dict) and "rate_mult" in leg:
+                    out.update(_flatten(
+                        leg, f"{path}[x{leg['rate_mult']}]."))
+            continue
+        if isinstance(v, dict):
+            out.update(_flatten(v, path + "."))
+            continue
+        m = _mean(v)
+        if m is not None:
+            out[path] = m
+    return out
+
+
+def compare(base: Dict[str, Any], new: Dict[str, Any],
+            tolerance: float = DEFAULT_TOLERANCE,
+            abs_floor: float = DEFAULT_ABS_FLOOR,
+            require: Tuple[str, ...] = (),
+            ) -> Tuple[List[str], List[str]]:
+    """Returns (regressions, notes). Empty regressions = gate passes."""
+    b = _flatten(_unwrap(base))
+    n = _flatten(_unwrap(new))
+    regressions: List[str] = []
+    notes: List[str] = []
+    for key in sorted(set(b) & set(n)):
+        d = direction(key)
+        if d is None:
+            continue
+        if require and not any(r in key for r in require):
+            continue
+        bv, nv = b[key], n[key]
+        if d == -1 and abs(bv) < abs_floor and abs(nv) < abs_floor:
+            continue  # both under the jitter floor: equal by fiat
+        if bv == 0:
+            continue  # no meaningful ratio (e.g. zeroed counter)
+        change = (nv - bv) / abs(bv)
+        worse = change * d < 0
+        mag = abs(change)
+        line = (f"{key}: {bv:.6g} -> {nv:.6g} "
+                f"({'+' if change >= 0 else ''}{change * 100:.1f}%)")
+        if worse and mag > tolerance:
+            regressions.append("REGRESSION " + line)
+        elif mag > tolerance:
+            notes.append("improved   " + line)
+        elif worse and mag > tolerance / 2:
+            notes.append("drift      " + line)
+    missing = [k for k in sorted(b) if k not in n and direction(k)]
+    for k in missing:
+        notes.append(f"missing    {k}: present in base, absent in new")
+    if require:
+        for r in require:
+            if not any(r in k for k in set(b) & set(n)):
+                regressions.append(
+                    f"REGRESSION required key {r!r} not comparable "
+                    f"(absent from one record)")
+    return regressions, notes
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Diff two bench/workload JSONs; exit 1 on regression")
+    p.add_argument("base")
+    p.add_argument("new")
+    p.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                   help="relative regression gate (default 0.15 = the "
+                        "measured CPU machine-phase drift; tighten for "
+                        "interleaved same-phase records)")
+    p.add_argument("--abs_floor", type=float, default=DEFAULT_ABS_FLOOR,
+                   help="seconds-scale values both below this compare "
+                        "equal (scheduler jitter floor)")
+    p.add_argument("--require", nargs="*", default=[],
+                   help="gate only keys containing these substrings, and "
+                        "fail if any is not comparable")
+    args = p.parse_args(argv)
+    with open(args.base) as f:
+        base = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+    regressions, notes = compare(base, new, tolerance=args.tolerance,
+                                 abs_floor=args.abs_floor,
+                                 require=tuple(args.require))
+    for line in notes:
+        print(line)
+    for line in regressions:
+        print(line)
+    print(f"compare_bench: {len(regressions)} regression(s), "
+          f"{len(notes)} note(s), tolerance ±{args.tolerance * 100:.0f}%")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

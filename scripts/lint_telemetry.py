@@ -31,6 +31,20 @@ chaos/faults test — a tests/ file that actually arms injection
 (``faults.configure(`` or ``EGPT_FAULTS``). A fault site nobody can
 reach from a test is exactly the dead handling code ``faults.py``
 exists to prevent.
+
+Rule 5 — bounded label cardinality (ISSUE 6 satellite): every labelled
+metric observation (``.inc(k=v)`` / ``.observe(x, k=v)`` /
+``.set(x, k=v)`` on a catalogued metric object) draws its label values
+from the fixed enum declared in the catalogue
+(``obs/metrics.py::METRIC_LABELS`` — a pure literal this lint reads
+with ``ast.literal_eval``). Violations: a label key with no declared
+enum, a literal value outside the enum, a computed value (f-string /
+str()/format — the unbounded shapes), a numeric literal, or a
+request-id-shaped label key (``rid``/``id``/...). Additionally every
+fault site found by rule 4's scan must be a member of
+``egpt_fault_trips_total``'s ``site`` enum, so a new site cannot ship
+without extending it. The metric classes re-enforce the enums at
+observe time; this rule catches the violation before anything runs.
 """
 
 from __future__ import annotations
@@ -62,6 +76,13 @@ _FAULT_SITE_RE = re.compile(
     r"maybe_(?:fail|delay)\(\s*['\"]([A-Za-z0-9_.]+)['\"]")
 # A tests/ file counts as a chaos/faults test iff it arms injection.
 _FAULT_TEST_RE = re.compile(r"faults\.configure\(|EGPT_FAULTS")
+# Rule 5: metric observation methods (labels arrive as kwargs) and the
+# non-label kwargs they accept; label keys that smell like per-request
+# identity are banned outright, whatever their values.
+_OBS_METHODS = ("inc", "observe", "set")
+_NON_LABEL_KWARGS = ("n",)
+_BANNED_LABEL_KEYS = ("rid", "request_id", "req_id", "id", "uid",
+                      "user", "user_id", "session_id")
 
 
 def _is_hot(rel: str) -> bool:
@@ -101,6 +122,7 @@ def run_lint(root: str) -> List[str]:
     """Returns the violation list (empty = clean)."""
     violations: List[str] = []
     seen: Dict[str, str] = {}  # metric name -> first registration site
+    parsed: List[tuple] = []   # (rel, src, tree) for the AST passes
     for path in _py_files(root):
         rel = os.path.relpath(path, root).replace(os.sep, "/")
         with open(path) as f:
@@ -110,6 +132,7 @@ def run_lint(root: str) -> List[str]:
         except SyntaxError as e:
             violations.append(f"{rel}: unparseable ({e})")
             continue
+        parsed.append((rel, src, tree))
         if _is_hot(rel):
             _check_time_time(rel, tree, violations)
         for m in _REG_RE.finditer(src):
@@ -132,15 +155,147 @@ def run_lint(root: str) -> List[str]:
         violations.append("no metric registrations found — the scan "
                           "pattern or tree layout changed under the lint")
     _check_catalogue(root, seen, violations)
-    _check_fault_coverage(root, violations)
+    fault_sites = _check_fault_coverage(root, violations)
+    _check_label_enums(parsed, fault_sites, violations)
     return violations
 
 
-def _check_fault_coverage(root: str, violations: List[str]) -> None:
+def _metric_var_map(parsed: List[tuple]) -> Dict[str, str]:
+    """Assignment targets bound to a metric registration, anywhere in
+    the scanned tree — how rule 5 resolves an observation's receiver
+    (``SERVE_TTFT.observe`` / ``obs_metrics.SERVE_TTFT.observe``) back
+    to its catalogue entry."""
+    out: Dict[str, str] = {}
+    for _rel, _src, tree in parsed:
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr in ("counter", "gauge",
+                                                 "histogram")
+                    and node.value.args
+                    and isinstance(node.value.args[0], ast.Constant)
+                    and isinstance(node.value.args[0].value, str)):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.value.args[0].value
+    return out
+
+
+def _metric_label_enums(parsed: List[tuple]) -> Dict[str, Dict[str, tuple]]:
+    """``METRIC_LABELS`` from obs/metrics.py — the declared enum
+    catalogue, read statically (it is a pure literal by contract)."""
+    for rel, _src, tree in parsed:
+        if not rel.endswith("obs/metrics.py"):
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "METRIC_LABELS"
+                            for t in node.targets)):
+                try:
+                    return ast.literal_eval(node.value)
+                except ValueError:
+                    return {}
+    return {}
+
+
+def _literal_label_values(node: ast.AST) -> List[str]:
+    """String literals an observation's label kwarg can evaluate to:
+    a Constant, or both arms of a conditional expression ('true' if ok
+    else 'false'). Empty = not statically resolvable."""
+    if isinstance(node, ast.Constant):
+        return [node.value] if isinstance(node.value, str) else []
+    if isinstance(node, ast.IfExp):
+        return (_literal_label_values(node.body)
+                + _literal_label_values(node.orelse))
+    return []
+
+
+def _check_label_enums(parsed: List[tuple], fault_sites: Dict[str, str],
+                       violations: List[str]) -> None:
+    """Rule 5: labelled observations stay inside the declared enums."""
+    var_map = _metric_var_map(parsed)
+    enums = _metric_label_enums(parsed)
+    for rel, _src, tree in parsed:
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _OBS_METHODS):
+                continue
+            recv = node.func.value
+            var = (recv.id if isinstance(recv, ast.Name)
+                   else recv.attr if isinstance(recv, ast.Attribute)
+                   else None)
+            metric = var_map.get(var or "")
+            if metric is None:
+                continue  # not a metric object (Event.set, queue, ...)
+            site = f"{rel}:{node.lineno}"
+            declared = enums.get(metric, {})
+            for kw in node.keywords:
+                if kw.arg is None or kw.arg in _NON_LABEL_KWARGS:
+                    continue
+                if kw.arg in _BANNED_LABEL_KEYS:
+                    violations.append(
+                        f"{site}: metric {metric!r} labelled with "
+                        f"{kw.arg!r} — per-request identity labels are "
+                        f"unbounded cardinality, banned outright")
+                    continue
+                allowed = declared.get(kw.arg)
+                if allowed is None:
+                    violations.append(
+                        f"{site}: metric {metric!r} label {kw.arg!r} has "
+                        f"no declared enum in obs/metrics.py "
+                        f"METRIC_LABELS — labelled observations must "
+                        f"draw values from a fixed catalogue enum")
+                    continue
+                if isinstance(kw.value, ast.JoinedStr) or (
+                        isinstance(kw.value, ast.Call)
+                        and isinstance(kw.value.func, ast.Name)
+                        and kw.value.func.id in ("str", "repr", "format")):
+                    violations.append(
+                        f"{site}: metric {metric!r} label {kw.arg!r} is "
+                        f"computed (f-string/str()) — unbounded label "
+                        f"values are banned; use an enum member")
+                    continue
+                if (isinstance(kw.value, ast.Constant)
+                        and not isinstance(kw.value.value, str)):
+                    violations.append(
+                        f"{site}: metric {metric!r} label {kw.arg!r} is "
+                        f"the non-string literal {kw.value.value!r} — "
+                        f"request-id-shaped labels are banned")
+                    continue
+                for lit in _literal_label_values(kw.value):
+                    if lit not in allowed:
+                        violations.append(
+                            f"{site}: metric {metric!r} label "
+                            f"{kw.arg!r}={lit!r} outside the declared "
+                            f"enum {tuple(allowed)}")
+                # Plain names/attributes pass statically; the metric
+                # classes validate them against the same enum at
+                # observe time (obs/metrics.py _key).
+    # The fault-trip site label must enumerate every wired site: a new
+    # maybe_fail site without an enum entry would raise at first trip.
+    trip_sites = enums.get("egpt_fault_trips_total", {}).get("site")
+    if trip_sites is not None:
+        for name, site in sorted(fault_sites.items()):
+            if name not in trip_sites:
+                violations.append(
+                    f"{site}: fault site {name!r} missing from "
+                    f"egpt_fault_trips_total's site enum "
+                    f"(obs/metrics.py METRIC_LABELS) — its first trip "
+                    f"would raise at observe time")
+
+
+def _check_fault_coverage(root: str,
+                          violations: List[str]) -> Dict[str, str]:
     """Rule 4: every wired fault site is reachable from a chaos/faults
     test (its literal name appears in a tests/ file that arms
     injection). The example spec in faults.py's own docstring names real
-    sites, which is fine — they must be covered anyway."""
+    sites, which is fine — they must be covered anyway. Returns the
+    site -> first-wiring-site map (rule 5 cross-checks it against the
+    egpt_fault_trips_total label enum)."""
     sites: Dict[str, str] = {}
     pkg = os.path.join(root, "eventgpt_tpu")
     for dirpath, _, files in os.walk(pkg):
@@ -170,13 +325,14 @@ def _check_fault_coverage(root: str, violations: List[str]) -> None:
         if os.path.isdir(pkg):
             violations.append("no fault sites found under eventgpt_tpu/ — "
                               "the scan pattern changed under the lint")
-        return
+        return sites
     for name, site in sorted(sites.items()):
         if name not in blob:
             violations.append(
                 f"{site}: fault site {name!r} is not exercised by any "
                 f"chaos/faults test (no tests/ file arming injection "
                 f"mentions it) — unreachable failure handling rots")
+    return sites
 
 
 def _check_catalogue(root: str, seen: Dict[str, str],

@@ -1,0 +1,103 @@
+"""Fast-tier guard for the checked-in bench artifacts (ISSUE 6
+satellite): ``BENCH_r0*.json`` / ``WORKLOAD_r0*.json`` must stay
+parseable and schema-stable, and ``scripts/compare_bench.py`` must keep
+gating them — so bench-output drift breaks tier-1 instead of silently
+rotting the perf trajectory (the regression gate later PRs cite is only
+as good as the records it diffs)."""
+
+import glob
+import importlib.util
+import json
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _compare_mod():
+    spec = importlib.util.spec_from_file_location(
+        "compare_bench", os.path.join(ROOT, "scripts", "compare_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_artifacts_schema():
+    paths = sorted(glob.glob(os.path.join(ROOT, "BENCH_r0*.json")))
+    assert paths, "no BENCH_r0*.json checked in"
+    for p in paths:
+        d = _load(p)
+        # Driver wrapper: round number, command, exit code, parsed record.
+        assert {"n", "cmd", "rc", "parsed"} <= set(d), p
+        rec = d["parsed"]
+        assert isinstance(rec.get("metric"), str) and rec["metric"], p
+        assert isinstance(rec.get("value"), (int, float)), p
+        assert isinstance(rec.get("unit"), str), p
+
+
+def test_workload_artifacts_schema():
+    """The acceptance shape: >= 2 offered-load points, >= 2 SLO classes,
+    goodput + per-class percentiles, and the interleaved telemetry+SLO
+    A/B holding the <2% overhead contract with byte-identical chains."""
+    paths = sorted(glob.glob(os.path.join(ROOT, "WORKLOAD_r0*.json")))
+    assert paths, "no WORKLOAD_r0*.json checked in"
+    for p in paths:
+        rec = _load(p)
+        assert rec["metric"].startswith("workload_goodput_"), p
+        assert rec["unit"] == "req/s", p
+        assert isinstance(rec["value"], (int, float)), p
+        sweep = rec["sweep"]
+        assert len(sweep) >= 2, f"{p}: need >= 2 offered-load points"
+        for leg in sweep:
+            for k in ("rate_mult", "offered_rps", "duration_s",
+                      "goodput_rps", "slo_met_ratio", "tok_s", "classes"):
+                assert k in leg, (p, k)
+            assert len(leg["classes"]) >= 2, \
+                f"{p}: need >= 2 SLO classes per point"
+            for cname, c in leg["classes"].items():
+                for k in ("requests", "met", "attainment", "ttft_p50_s",
+                          "ttft_p99_s", "itl_p50_s", "itl_p99_s",
+                          "latency_p50_s", "latency_p99_s"):
+                    assert k in c, (p, cname, k)
+        ab = rec["ab"]
+        assert ab["chains_identical"] is True, \
+            f"{p}: SLO-armed replay diverged from plain submit"
+        assert ab["overhead_frac"] < 0.02, \
+            f"{p}: telemetry+SLO overhead {ab['overhead_frac']} breaks " \
+            f"the <2% contract"
+
+
+def test_compare_bench_gates_checked_in_rounds():
+    """Smoke the regression gate on two committed rounds: r04 -> r05 is
+    a known-clean transition (it must pass), and the reverse direction
+    must fire (the gate has teeth, not just a green lamp)."""
+    mod = _compare_mod()
+    base = os.path.join(ROOT, "BENCH_r04.json")
+    new = os.path.join(ROOT, "BENCH_r05.json")
+    regs, notes = mod.compare(_load(base), _load(new))
+    assert regs == [], f"r04 -> r05 should gate clean: {regs}"
+    back, _ = mod.compare(_load(new), _load(base))
+    assert back, "reversing a known improvement must register as a " \
+                 "regression"
+    # The CLI wrapper agrees with the library result.
+    assert mod.main([base, new]) == 0
+    assert mod.main([new, base]) == 1
+
+
+def test_compare_bench_handles_workload_records():
+    """Workload records diff pointwise by rate_mult; an identical record
+    gates clean against itself and a degraded goodput fires."""
+    mod = _compare_mod()
+    paths = sorted(glob.glob(os.path.join(ROOT, "WORKLOAD_r0*.json")))
+    rec = _load(paths[0])
+    regs, _ = mod.compare(rec, rec)
+    assert regs == []
+    worse = json.loads(json.dumps(rec))
+    for leg in worse["sweep"]:
+        leg["goodput_rps"] = leg["goodput_rps"] * 0.5
+    regs, _ = mod.compare(rec, worse)
+    assert any("goodput_rps" in r for r in regs)

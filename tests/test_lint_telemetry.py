@@ -63,6 +63,81 @@ def test_lint_catches_each_violation_class(tmp_path):
     assert not any("covered.site" in s for s in v)
 
 
+def test_lint_rule5_label_enums(tmp_path):
+    """Rule 5 (ISSUE 6): labelled observations must draw values from the
+    enum declared in METRIC_LABELS — out-of-enum literals, computed
+    values, request-id-shaped keys, undeclared labels and fault sites
+    missing from the trip enum are each their own violation class."""
+    lint = _lint()
+    pkg = tmp_path / "eventgpt_tpu"
+    (pkg / "obs").mkdir(parents=True)
+    (pkg / "obs" / "metrics.py").write_text(
+        'METRIC_LABELS = {\n'
+        '    "egpt_l_requests_total": {"status": ("ok", "bad")},\n'
+        '    "egpt_fault_trips_total": {"site": ("known.site",),\n'
+        '                               "kind": ("fail", "delay")},\n'
+        '}\n'
+        'L = R.counter(\n    "egpt_l_requests_total", "x")\n'
+        'U = R.counter(\n    "egpt_u_total", "x")\n'
+        'T = R.counter(\n    "egpt_fault_trips_total", "x")\n'
+    )
+    (pkg / "call_sites.py").write_text(
+        'L.inc(status="ok")\n'                      # in-enum: clean
+        'L.inc(status="ok" if x else "bad")\n'      # both arms in-enum
+        'L.inc(status=current)\n'                   # name: runtime-checked
+        'L.inc(status="nope")\n'                    # out of enum
+        'L.inc(rid="7")\n'                          # banned identity key
+        'L.inc(status=f"s{x}")\n'                   # computed value
+        'L.inc(status=123)\n'                       # numeric literal
+        'U.inc(kind="a")\n'                         # no declared enum
+        'ev.set()\n'                                # not a metric: ignored
+    )
+    # A wired fault site absent from the trip enum must be flagged too.
+    (pkg / "faulty.py").write_text(
+        'faults.maybe_fail("known.site")\n'
+        'faults.maybe_fail("new.site")\n')
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "test_chaos.py").write_text(
+        'faults.configure("known.site:n=1")\nEGPT_FAULTS\n'
+        '# new.site covered here too\n')
+    (tmp_path / "OBSERVABILITY.md").write_text(
+        "`egpt_l_requests_total` `egpt_u_total` `egpt_fault_trips_total`\n")
+    v = lint.run_lint(str(tmp_path))
+    assert any("label 'status'='nope' outside the declared enum" in s
+               for s in v)
+    assert any("labelled with 'rid'" in s for s in v)
+    assert any("label 'status' is computed" in s for s in v)
+    assert any("non-string literal 123" in s for s in v)
+    assert any("label 'kind' has no declared enum" in s for s in v)
+    assert any("fault site 'new.site' missing from" in s for s in v)
+    # The clean shapes stay clean: in-enum literals (line 1), both-arms-
+    # in-enum conditionals (2), plain names (3) and non-metric .set()
+    # receivers (9) produce no rule-5 violation.
+    assert not any(f"call_sites.py:{ln}:" in s for s in v
+                   for ln in (1, 2, 3, 9))
+    assert not any("'known.site' missing" in s for s in v)
+
+
+def test_metric_label_enum_enforced_at_observe_time():
+    """The runtime backstop for rule 5: a catalogued metric refuses an
+    out-of-enum label value instead of minting a fresh series."""
+    import pytest
+
+    from eventgpt_tpu.obs import metrics as obs_metrics
+
+    with pytest.raises(ValueError, match="outside the declared enum"):
+        obs_metrics.SERVE_REQUESTS.inc(status="rid-12345")
+    with pytest.raises(ValueError, match="outside the declared enum"):
+        obs_metrics.SERVE_SLO_REQUESTS.inc(slo_class="vip", met="true")
+    # In-enum values still count (and leave the registry consistent).
+    before = obs_metrics.SERVE_SLO_REQUESTS.value(
+        slo_class="interactive", met="true")
+    obs_metrics.SERVE_SLO_REQUESTS.inc(slo_class="interactive", met="true")
+    assert obs_metrics.SERVE_SLO_REQUESTS.value(
+        slo_class="interactive", met="true") == before + 1
+
+
 def test_lint_fails_closed_when_nothing_found(tmp_path):
     # An empty tree means the scan itself broke — that must be a
     # violation, not a pass.

@@ -458,6 +458,66 @@ def _tiny_event_b64(tmp_path, n=4000):
         return base64.b64encode(f.read()).decode()
 
 
+def test_slo_class_scoring_over_http(tmp_path):
+    """ISSUE 6: a payload ``slo_class`` scores the request against the
+    server's targets at finish — the response echoes class + attainment,
+    /stats carries per-class attainment, /metrics exposes the
+    ``egpt_serve_slo_*`` series — and an unknown class (the label enum
+    is closed) is the client's fault, not a fresh metric series."""
+    import jax
+
+    from eventgpt_tpu.cli.serve import ServingEngine, make_handler
+    from eventgpt_tpu.config import EventChatConfig
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.models import eventchat
+    from eventgpt_tpu.serve import ContinuousBatcher
+    from http.server import ThreadingHTTPServer
+
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None)
+    engine = ServingEngine(srv, load_tokenizer("byte"))
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0), make_handler(engine, cfg))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        b64 = _tiny_event_b64(tmp_path)
+        # batch class, generous default latency target: met.
+        ok = _post(url, {"query": "What is happening?", "event_b64": b64,
+                         "max_new_tokens": 6, "slo_class": "batch"})
+        assert ok["slo_class"] == "batch" and ok["slo_met"] is True
+        # interactive with an impossible per-request TTFT override: miss.
+        miss = _post(url, {"query": "What is happening?", "event_b64": b64,
+                           "max_new_tokens": 6, "slo_class": "interactive",
+                           "slo_ttft_s": 1e-9})
+        assert miss["slo_class"] == "interactive"
+        assert miss["slo_met"] is False
+        with urllib.request.urlopen(url + "/stats", timeout=60) as r:
+            s = json.loads(r.read())
+        assert s["slo"]["classes"]["batch"]["finished"] >= 1
+        assert s["slo"]["classes"]["interactive"]["met"] == 0
+        assert 0.0 <= s["slo"]["goodput_ratio"] <= 1.0
+        with urllib.request.urlopen(url + "/metrics", timeout=60) as r:
+            text = r.read().decode()
+        assert 'egpt_serve_slo_requests_total{' in text
+        assert 'slo_class="batch"' in text
+        # Closed class set: unknown names are a 400, never a new series.
+        bad = urllib.request.Request(
+            url + "/v1/generate",
+            json.dumps({"query": "x", "event_b64": b64,
+                        "slo_class": "vip"}).encode(),
+            {"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(bad, timeout=60)
+        assert e.value.code == 400
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        engine.shutdown()
+
+
 def test_prefix_route_reuses_kv_and_keeps_chains(tmp_path):
     """VERDICT residue: shared-prefix KV reuse through the PRODUCT HTTP
     server. POST /prefix installs the conversation head's KV once; the

@@ -1,0 +1,253 @@
+"""Trace-driven workloads + SLO goodput (ISSUE 6).
+
+Three contracts under test: (1) a trace is a pure function of its spec
+— same seed, byte-identical JSONL; (2) SLO attainment scores exactly on
+the documented boundaries (inclusive targets), on synthetic clocks so
+the assertions are exact; (3) SLO scoring and its telemetry are purely
+observational — replaying with classes armed commits byte-identical
+greedy chains vs plain ``submit``.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from eventgpt_tpu import workload as wl
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
+from eventgpt_tpu.models import eventchat
+from eventgpt_tpu.obs import metrics as obs_metrics
+from eventgpt_tpu.serve import ContinuousBatcher
+
+
+# -- trace generation / persistence ---------------------------------------
+
+
+@pytest.mark.parametrize("arrival", ["poisson", "gamma", "onoff"])
+def test_same_seed_byte_identical_jsonl(tmp_path, arrival):
+    spec = wl.WorkloadSpec(seed=7, n_requests=24, rate_rps=20.0,
+                           arrival=arrival, sessions=3)
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    wl.save_trace(str(a), spec, wl.generate_trace(spec))
+    wl.save_trace(str(b), spec, wl.generate_trace(spec))
+    assert a.read_bytes() == b.read_bytes()
+    spec2, trace2 = wl.load_trace(str(a))
+    assert spec2 == spec
+    assert trace2 == wl.generate_trace(spec)
+
+
+def test_different_seed_differs(tmp_path):
+    t0 = wl.generate_trace(wl.WorkloadSpec(seed=0, n_requests=16))
+    t1 = wl.generate_trace(wl.WorkloadSpec(seed=1, n_requests=16))
+    assert t0 != t1
+
+
+def test_trace_shape_invariants():
+    spec = wl.WorkloadSpec(seed=3, n_requests=64, rate_rps=30.0,
+                           arrival="gamma", sessions=3)
+    trace = wl.generate_trace(spec)
+    assert len(trace) == 64
+    arrivals = [r.t_arrival for r in trace]
+    assert arrivals == sorted(arrivals)
+    assert {r.kind for r in trace} <= set(wl.KINDS)
+    assert {r.slo_class for r in trace} == set(wl.SLO_CLASSES)
+    for r in trace:
+        assert r.input_ids.count(EVENT_TOKEN_INDEX) == 1
+        assert spec.output_min <= r.max_new_tokens <= spec.output_max
+    # The session mix exercises the radix cache: some prompt must be a
+    # PROPER prefix of a later one (chat turns extend their dialog,
+    # stream re-submits repeat a head).
+    ids = [tuple(r.input_ids) for r in trace]
+    assert any(
+        len(a) < len(b) and b[: len(a)] == a
+        for i, a in enumerate(ids) for b in ids[i + 1:]
+    )
+
+
+def test_onoff_arrivals_are_clumped():
+    """The on-off process must leave silences >= off_s between bursts —
+    the burstiness the Poisson arm never produces at this rate."""
+    spec = wl.WorkloadSpec(seed=2, n_requests=48, rate_rps=10.0,
+                           arrival="onoff", on_s=0.5, off_s=2.0)
+    t = [r.t_arrival for r in wl.generate_trace(spec)]
+    gaps = np.diff(t)
+    assert (gaps >= spec.off_s).any()
+
+
+# -- SLO scoring (synthetic values: exact boundaries) ----------------------
+
+
+def test_slo_met_is_inclusive_on_every_target():
+    slo = wl.SLO("interactive", ttft_s=1.0, itl_s=0.1, latency_s=10.0)
+    assert slo.met(1.0, 0.1, 10.0)            # exactly on ALL targets
+    assert not slo.met(1.0 + 1e-9, 0.1, 10.0)  # past TTFT only
+    assert not slo.met(1.0, 0.1 + 1e-9, 10.0)  # past ITL only
+    assert not slo.met(1.0, 0.1, 10.0 + 1e-9)  # past latency only
+    # Unarmed targets are ignored entirely.
+    assert wl.SLO("batch", latency_s=5.0).met(99.0, 99.0, 5.0)
+    assert not wl.SLO("batch", latency_s=5.0).met(0.0, 0.0, 5.1)
+    assert wl.SLO("interactive").met(1e9, 1e9, 1e9)  # nothing armed
+
+
+def test_spec_slo_for_classes():
+    spec = wl.WorkloadSpec(interactive_ttft_s=0.5, interactive_itl_s=0.05,
+                           batch_latency_s=12.0)
+    inter = spec.slo_for("interactive")
+    assert (inter.name, inter.ttft_s, inter.itl_s,
+            inter.latency_s) == ("interactive", 0.5, 0.05, None)
+    batch = spec.slo_for("batch")
+    assert (batch.name, batch.latency_s) == ("batch", 12.0)
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        spec.slo_for("vip")
+
+
+def test_slo_classes_match_metric_label_enum():
+    """The class tuple and the metric-label enum are declared in two
+    places (workload.py is jax-free, METRIC_LABELS is a pure literal);
+    they must never drift apart."""
+    enum = obs_metrics.METRIC_LABELS["egpt_serve_slo_requests_total"]
+    assert tuple(enum["slo_class"]) == wl.SLO_CLASSES
+
+
+# -- batcher-level scoring on synthetic clocks -----------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(5))
+    return cfg, params
+
+
+def _pv(cfg, seed=0):
+    return wl.stream_pixels(
+        (cfg.num_event_frames, 3, cfg.vision.image_size,
+         cfg.vision.image_size), seed)
+
+
+def _scored(tiny, monkeypatch, slo, t_first, t_last, t_done, n_tokens=4):
+    """Drive _record_finish with hand-set timestamps (synthetic clock):
+    the scoring must read exactly these, nothing real-time."""
+    import eventgpt_tpu.serve as serve_mod
+
+    cfg, params = tiny
+    srv = ContinuousBatcher(params, cfg, max_batch=1, max_len=256, chunk=4)
+    req = serve_mod._Request(0, [1, EVENT_TOKEN_INDEX, 5], None, n_tokens)
+    req.slo = slo
+    req.t_submit = 100.0
+    req.t_first = 100.0 + t_first if t_first is not None else None
+    req.t_last = 100.0 + t_last if t_last is not None else None
+    req.tokens = list(range(n_tokens))
+    monkeypatch.setattr(serve_mod.time, "perf_counter",
+                        lambda: 100.0 + t_done)
+    srv._record_finish(req, serve_mod.STATUS_OK)
+    return srv
+
+
+def test_batcher_scores_exactly_on_targets_as_met(tiny, monkeypatch):
+    # ttft = 1.0, itl = (1.3 - 1.0) / 3 = 0.1, latency = 10.0 — each
+    # EXACTLY on its target: met.
+    slo = wl.SLO("interactive", ttft_s=1.0, itl_s=0.1, latency_s=10.0)
+    srv = _scored(tiny, monkeypatch, slo, t_first=1.0, t_last=1.3,
+                  t_done=10.0, n_tokens=4)
+    st = srv.slo_stats()
+    assert st["classes"]["interactive"] == {
+        "finished": 1, "met": 1, "attainment": 1.0}
+    assert st["goodput_ratio"] == 1.0
+    assert srv.request_stats[0]["slo_met"] == 1.0
+    assert srv.request_stats[0]["itl_s"] == pytest.approx(0.1)
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(t_first=1.2, t_last=1.5, t_done=10.0),   # past TTFT
+    dict(t_first=1.0, t_last=1.6, t_done=10.0),   # past ITL (0.2 > 0.1)
+    dict(t_first=1.0, t_last=1.3, t_done=10.5),   # past latency
+])
+def test_batcher_scores_past_any_target_as_missed(tiny, monkeypatch,
+                                                  kwargs):
+    slo = wl.SLO("interactive", ttft_s=1.0, itl_s=0.1, latency_s=10.0)
+    srv = _scored(tiny, monkeypatch, slo, n_tokens=4, **kwargs)
+    st = srv.slo_stats()
+    assert st["classes"]["interactive"]["met"] == 0
+    assert st["goodput_ratio"] == 0.0
+    assert srv.request_stats[0]["slo_met"] == 0.0
+
+
+def test_never_committed_request_scores_on_t_done_standin(tiny,
+                                                          monkeypatch):
+    """A forced finish with no first token scores TTFT on its t_done
+    stand-in — it stays in the goodput denominator (Sarathi counts
+    completions within SLO; vanishing misses would inflate goodput)."""
+    slo = wl.SLO("interactive", ttft_s=1.0)
+    srv = _scored(tiny, monkeypatch, slo, t_first=None, t_last=None,
+                  t_done=5.0, n_tokens=0)
+    assert srv.slo_stats()["classes"]["interactive"]["met"] == 0
+
+
+def test_unknown_slo_class_rejected_at_submit(tiny):
+    cfg, params = tiny
+    srv = ContinuousBatcher(params, cfg, max_batch=1, max_len=256, chunk=4)
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        srv.submit([1, EVENT_TOKEN_INDEX, 5], _pv(cfg), 4,
+                   slo=wl.SLO("vip", ttft_s=1.0))
+
+
+# -- chain neutrality + replay determinism ---------------------------------
+
+
+def _trace_and_spec():
+    spec = wl.WorkloadSpec(seed=11, n_requests=8, rate_rps=100.0,
+                           arrival="gamma", sessions=2, prompt_max=16,
+                           output_min=2, output_max=6,
+                           interactive_ttft_s=0.5, interactive_itl_s=0.1,
+                           batch_latency_s=5.0)
+    return spec, wl.generate_trace(spec)
+
+
+def test_replay_with_slo_armed_is_chain_identical_to_plain_submit(tiny):
+    """The acceptance property: SLO classes + goodput telemetry never
+    touch a jax value, so the greedy chains are byte-identical whether
+    requests carry SLOs (telemetry armed) or not (disarmed, plain
+    submit) — and identical across paced/unpaced schedules (rows are
+    independent in attention)."""
+    cfg, params = tiny
+    spec, trace = _trace_and_spec()
+
+    def pixels_for(r):
+        return _pv(cfg, r.pixels_seed)
+
+    def run(armed):
+        obs_metrics.configure(armed)
+        try:
+            srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256,
+                                    chunk=4, eos_token_id=None)
+            res = wl.replay(
+                srv, trace, pixels_for=pixels_for, paced=False,
+                slo_for=(lambda r: spec.slo_for(r.slo_class))
+                if armed else None)
+            return res["finished"], srv
+        finally:
+            obs_metrics.configure(True)
+
+    armed_chains, armed_srv = run(True)
+    plain_chains, plain_srv = run(False)
+    assert armed_chains == plain_chains
+    # The armed run scored every request; the plain run scored none.
+    armed_st = armed_srv.slo_stats()
+    assert sum(c["finished"] for c in armed_st["classes"].values()) == 8
+    assert plain_srv.slo_stats()["classes"] == {}
+    assert set(armed_st["classes"]) == set(wl.SLO_CLASSES)
+
+
+def test_replay_is_deterministic_across_runs(tiny):
+    cfg, params = tiny
+    spec, trace = _trace_and_spec()
+
+    def run():
+        srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256,
+                                chunk=4, eos_token_id=None)
+        return wl.replay(srv, trace,
+                         pixels_for=lambda r: _pv(cfg, r.pixels_seed),
+                         paced=False)["finished"]
+
+    assert run() == run()
