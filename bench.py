@@ -678,6 +678,11 @@ def run_workload(args):
     if args.workload_save:
         wl.save_trace(args.workload_save, spec, trace)
 
+    if int(getattr(args, "fleet", 0) or 0) > 1:
+        # Fleet leg (ISSUE 7): the same trace through the router tier.
+        return _run_workload_fleet(args, preset, cfg, platform, params,
+                                   spec, trace)
+
     # Size the server to the trace (speculative slack included), like
     # submit() will re-validate per request.
     need = max(wl.cache_positions(r, cfg.num_event_tokens)
@@ -876,6 +881,235 @@ def run_workload(args):
             json.dump(record, f, indent=2)
             f.write("\n")
     return record
+
+
+def _run_workload_fleet(args, preset, cfg, platform, params, spec, trace):
+    """``--mode workload --fleet N`` (ISSUE 7): replay the same seeded
+    trace through the replica supervisor + prefix-affinity router
+    instead of one batcher. Per sweep point the record carries the
+    single-engine keys (goodput, SLO-met ratio, per-class percentiles,
+    tok/s) PLUS the fleet-only keys: per-replica goodput / hit ratio /
+    served counts, shed and rejected totals, and failover counts —
+    the router's observability story under load. Engines self-drive
+    (each replica runs its own scheduler thread), so the replay here
+    only paces submissions and collects results."""
+    import numpy as np
+
+    from eventgpt_tpu import workload as wl
+    from eventgpt_tpu.cli.serve import ServingEngine
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.fleet import Fleet, FleetShedError
+    from eventgpt_tpu.obs import metrics as obs_metrics
+    from eventgpt_tpu.serve import ContinuousBatcher, QueueFullError
+
+    n_fleet = int(args.fleet)
+    telemetry = bool(args.serve_telemetry)
+    obs_metrics.configure(telemetry)
+    need = max(wl.cache_positions(r, cfg.num_event_tokens)
+               + r.max_new_tokens for r in trace)
+    max_len = ((need + 1 + args.serve_spec + 127) // 128) * 128
+    batchers = [
+        ContinuousBatcher(
+            params, cfg, max_batch=args.serve_batch, max_len=max_len,
+            chunk=args.serve_chunk, eos_token_id=None,
+            kv_quant=args.kv == "int8", speculative=args.serve_spec,
+            first_chunk=args.serve_first_chunk or 0,
+            pipeline=bool(args.serve_pipeline),
+            prefix_cache=bool(args.serve_prefix_cache),
+            prefix_insert=bool(args.serve_cache_insert),
+            prefill_budget=int(args.serve_prefill_budget),
+        )
+        for _ in range(n_fleet)
+    ]
+    shape = (cfg.num_event_frames, 3, cfg.vision.image_size,
+             cfg.vision.image_size)
+    pix_cache = {}
+
+    def pixels_for(r):
+        if r.pixels_seed not in pix_cache:
+            pix_cache[r.pixels_seed] = wl.stream_pixels(shape, r.pixels_seed)
+        return pix_cache[r.pixels_seed]
+
+    plens = sorted({wl.cache_positions(r, cfg.num_event_tokens)
+                    for r in trace})
+    t0 = time.perf_counter()
+    # The replicas share the jit executable cache (identical shapes), so
+    # warming each is one compile pass + (N-1) cache hits.
+    warmed = (sum(b.warmup(prompt_lens=plens) for b in batchers)
+              if args.warmup else 0)
+    t_warm = time.perf_counter() - t0
+
+    engines = [ServingEngine(b, load_tokenizer("byte")) for b in batchers]
+    fleet = Fleet(
+        engines, probe_interval_s=0.02,
+        shed_goodput_ratio=float(getattr(args, "fleet_shed_goodput", 0.5)),
+        shed_queue_depth=int(getattr(args, "fleet_shed_queue", 0)),
+    )
+
+    def slo_for(r):
+        return spec.slo_for(r.slo_class)
+
+    def replay(rate_mult, paced=True, with_slo=True):
+        tr0 = time.perf_counter()
+        frids = {}
+        shed = rejected = 0
+        for r in trace:
+            if paced:
+                while True:
+                    dt = r.t_arrival / rate_mult - (time.perf_counter()
+                                                    - tr0)
+                    if dt <= 0:
+                        break
+                    time.sleep(min(dt, 0.005))
+            try:
+                frids[r.idx] = fleet.submit_ids(
+                    r.input_ids, pixels_for(r), r.max_new_tokens,
+                    slo=slo_for(r) if with_slo else None)
+            except FleetShedError:
+                shed += 1
+            except QueueFullError:
+                rejected += 1
+        finished = {idx: fleet.result(f, timeout=600)
+                    for idx, f in frids.items()}
+        return {"frids": frids, "finished": finished,
+                "duration_s": time.perf_counter() - tr0,
+                "shed": shed, "rejected": rejected}
+
+    def reset_point():
+        fleet.reset_stats()
+        for b in batchers:
+            b.reset_serving_stats()
+            if b._prefix_cache is not None and bool(args.serve_cache_insert):
+                b._prefix_cache = type(b._prefix_cache)(b._prefix_cache.budget)
+        obs_metrics.REGISTRY.reset()
+
+    if args.warmup:
+        # Cold-trajectory priming, fleet form: one unmeasured unpaced
+        # replay compiles the trace's wave/suffix/lane shapes on every
+        # replica the router touches.
+        replay(1.0, paced=False, with_slo=False)
+
+    class_of = {r.idx: r.slo_class for r in trace}
+    span = max(r.t_arrival for r in trace) or 1e-9
+    mults = [float(x) for x in args.workload_mults.split(",") if x]
+    sweep = []
+    for mult in mults:
+        reset_point()
+        res = replay(mult, paced=True)
+        st = fleet.slo_stats()
+        met_total = sum(c["met"] for c in st["classes"].values())
+        fin_total = sum(c["finished"] for c in st["classes"].values())
+        toks = sum(len(v) for v in res["finished"].values())
+        stats_of = fleet.batcher.request_stats
+        per_class = {}
+        for cname, cagg in sorted(st["classes"].items()):
+            stats = [stats_of.get(res["frids"][idx])
+                     for idx in res["frids"] if class_of[idx] == cname]
+            stats = [s for s in stats if s]
+
+            def pct(key, q):
+                vals = [s[key] for s in stats if key in s]
+                return round(float(np.percentile(vals, q)), 4) if vals \
+                    else 0.0
+
+            per_class[cname] = {
+                "requests": cagg["finished"],
+                "met": cagg["met"],
+                "attainment": round(cagg["attainment"], 4),
+                "ttft_p50_s": pct("ttft_s", 50),
+                "ttft_p99_s": pct("ttft_s", 99),
+                "itl_p50_s": pct("itl_s", 50),
+                "itl_p99_s": pct("itl_s", 99),
+                "latency_p50_s": pct("latency_s", 50),
+                "latency_p99_s": pct("latency_s", 99),
+            }
+        served_by = {}
+        for idx, frid in res["frids"].items():
+            rep = fleet.replica_of(frid)
+            served_by.setdefault(rep, []).append(idx)
+        replicas = []
+        for rep in fleet.replicas:
+            rst = rep.engine.batcher.slo_stats()
+            rmet = sum(c["met"] for c in rst["classes"].values())
+            rfin = sum(c["finished"] for c in rst["classes"].values())
+            replicas.append({
+                "replica": rep.idx,
+                "requests": rfin,
+                "goodput_rps": round(rmet / res["duration_s"], 3),
+                "slo_met_ratio": round(rmet / max(rfin, 1), 4),
+                "tokens": sum(len(res["finished"][i])
+                              for i in served_by.get(rep.idx, [])),
+                "prefix_cache_hit_ratio": round(
+                    rep.engine.batcher.prefix_cache_stats().get(
+                        "hit_ratio", 0.0), 3),
+            })
+        hits = sum(r.engine.batcher.prefix_cache_stats().get("hits", 0)
+                   for r in fleet.replicas)
+        misses = sum(r.engine.batcher.prefix_cache_stats().get("misses", 0)
+                     for r in fleet.replicas)
+        sweep.append({
+            "rate_mult": mult,
+            "offered_rps": round(len(trace) / (span / mult), 3),
+            "duration_s": round(res["duration_s"], 3),
+            "goodput_rps": round(met_total / res["duration_s"], 3),
+            "slo_met_ratio": round(met_total / max(fin_total, 1), 4),
+            "tok_s": round(toks / res["duration_s"], 2),
+            "prefix_cache_hit_ratio": round(
+                hits / (hits + misses), 3) if (hits + misses) else 0.0,
+            "classes": per_class,
+            # fleet-only keys from here down (OBSERVABILITY.md "Fleet
+            # workload record" documents them; compare_bench gates only
+            # the direction-aware shared keys above):
+            "shed_total": res["shed"],
+            "rejected_total": res["rejected"],
+            "failovers": fleet.n_failovers,
+            "replicas": replicas,
+        })
+
+    record = {
+        "metric": f"workload_fleet_goodput_{preset}",
+        "value": (next((l for l in sweep if l["rate_mult"] == 1.0),
+                       sweep[0])["goodput_rps"] if sweep else 0.0),
+        "unit": "req/s",
+        "fleet": n_fleet,
+        "requests": len(trace),
+        "arrival": spec.arrival,
+        "rate_rps": spec.rate_rps,
+        "sessions": spec.sessions,
+        "seed": spec.seed,
+        "slo": {
+            "interactive": {"ttft_s": spec.interactive_ttft_s,
+                            "itl_s": spec.interactive_itl_s},
+            "batch": {"latency_s": spec.batch_latency_s},
+        },
+        "shed_goodput_ratio": float(getattr(args, "fleet_shed_goodput", 0.5)),
+        "shed_queue_depth": int(getattr(args, "fleet_shed_queue", 0)),
+        "max_batch": args.serve_batch,
+        "chunk": args.serve_chunk,
+        "prefill_budget": int(args.serve_prefill_budget),
+        "pipeline": bool(args.serve_pipeline),
+        "prefix_cache": bool(args.serve_prefix_cache),
+        "warmup": bool(args.warmup),
+        "warmup_s": round(t_warm, 3),
+        "warmed_executables": warmed,
+        "sweep": sweep,
+        "kv_cache": args.kv,
+        "speculative": args.serve_spec,
+        "quant": quant_name(args, preset),
+        "platform": platform,
+        "telemetry": telemetry,
+    }
+    fleet.shutdown()
+    print(json.dumps(record))
+    if args.workload_out:
+        with open(args.workload_out, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    return record
+
+
+def quant_name(args, preset):
+    return args.quant if preset in ("7b", "13b") else "bf16"
 
 
 def run_stream(args):
@@ -1503,6 +1737,22 @@ def run_all(args):
         except Exception as e:
             sys.stderr.write(f"workload{tag} leg failed: {e}\n")
 
+    # Fleet serving (ISSUE 7): the same bursty trace through 2 replicas
+    # behind the prefix-affinity router — aggregate goodput plus the
+    # router-tier counters (shed/failovers) land in the round record.
+    try:
+        sv = _leg(wl_base + ["--fleet", "2",
+                             "--serve_prefill_budget", "128"])
+        record["workload_fleet2_goodput_rps"] = sv["value"]
+        legs = sv.get("sweep") or [{}]
+        record["workload_fleet2_slo_met_ratio"] = \
+            legs[0].get("slo_met_ratio")
+        record["workload_fleet2_tok_s"] = legs[0].get("tok_s")
+        record["workload_fleet2_shed_total"] = legs[0].get("shed_total")
+        record["workload_fleet2_failovers"] = legs[0].get("failovers")
+    except Exception as e:
+        sys.stderr.write(f"workload fleet leg failed: {e}\n")
+
     print(json.dumps(record))
 
 
@@ -1547,6 +1797,18 @@ def main() -> None:
     p.add_argument("--workload_out", default=None,
                    help="mode=workload: also write the record as a "
                         "pretty-printed WORKLOAD_r0N.json artifact")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="mode=workload: replay through N ServingEngine "
+                        "replicas behind the prefix-affinity router "
+                        "(ISSUE 7); 0/1 = the single-batcher replay")
+    p.add_argument("--fleet_shed_goodput", type=float, default=0.5,
+                   help="fleet leg: shed batch-class requests while the "
+                        "aggregate windowed goodput ratio is below this "
+                        "(0 disarms)")
+    p.add_argument("--fleet_shed_queue", type=int, default=0,
+                   help="fleet leg: shed batch-class requests while the "
+                        "aggregate queue depth is at/above this "
+                        "(0 disarms)")
     p.add_argument("--slo_ttft_s", type=float, default=1.0,
                    help="interactive-class TTFT target (0 disarms)")
     p.add_argument("--slo_itl_s", type=float, default=0.25,

@@ -52,6 +52,7 @@ import argparse
 import base64
 import itertools
 import json
+import math
 import queue
 import threading
 import time
@@ -116,6 +117,10 @@ class ServingEngine:
         self._t_fault = 0.0        # monotonic time of the last fault
         self.breaker_threshold = max(int(breaker_threshold), 1)
         self.breaker_cooldown_s = float(breaker_cooldown_s)
+        # Fleet kill state (ISSUE 7): a killed replica parks its
+        # scheduler loop and refuses submits until revive() — the
+        # supervisor drained its requests for re-admission elsewhere.
+        self._dead = False
         self._n_steps = 0
         self._heartbeat = None
         self._hb_interval = float(heartbeat_interval_s)
@@ -149,16 +154,27 @@ class ServingEngine:
         from eventgpt_tpu.data.conversation import prepare_event_prompt
         from eventgpt_tpu.data.tokenizer import tokenize_with_event
 
-        if self.breaker_open():
-            raise RuntimeError(f"serving engine is down: {self.fault}")
         ids = tokenize_with_event(
             prepare_event_prompt(query, self.conv_mode), self.tokenizer
         )
+        return self.submit_ids(ids, pixels, max_new_tokens, stream=stream,
+                               deadline_s=deadline_s, slo=slo)
+
+    def submit_ids(self, ids, pixels, max_new_tokens: int,
+                   stream: bool = False,
+                   deadline_s: Optional[float] = None,
+                   slo=None) -> int:
+        """``submit`` for a pre-tokenized prompt — the fleet router's
+        entry point (it tokenized once already, to compute the request's
+        prefix-affinity key)."""
+        if self.breaker_open() or self._dead:
+            raise RuntimeError(f"serving engine is down: {self.fault}")
         with self._lock:
-            # Re-check under the lock: a breaker trip while we tokenized
-            # has already swept _done — an event registered after the
-            # sweep would burn its caller's full timeout.
-            if self.breaker_open():
+            # Re-check under the lock: a breaker trip (or kill) while
+            # the caller prepared the request has already swept _done —
+            # an event registered after the sweep would burn its
+            # caller's full timeout.
+            if self.breaker_open() or self._dead:
                 raise RuntimeError(f"serving engine is down: {self.fault}")
             rid = self.batcher.submit(ids, pixels, max_new_tokens,
                                       deadline_s=deadline_s, slo=slo)
@@ -224,6 +240,88 @@ class ServingEngine:
                     f"serving engine is down: "
                     f"{self.fault or self._status.get(rid, 'unknown fault')}")
             return self._answers.pop(rid)
+
+    def try_result(self, rid: int):
+        """Non-blocking collection for the fleet supervisor: ``(tokens,
+        status)`` once the request is terminal — ``(None,
+        "engine_fault")`` when a scheduler fault failed it (the
+        supervisor's cue to fail it over) — else ``None`` (still
+        running). Consuming: a delivered answer is popped, like
+        ``result``."""
+        with self._lock:
+            if rid in self._answers:
+                self._done.pop(rid, None)
+                return self._answers.pop(rid), self._status.get(rid, "ok")
+            if self._status.get(rid) == "engine_fault":
+                self._done.pop(rid, None)
+                return None, "engine_fault"
+        return None
+
+    def try_status(self, rid: int):
+        """Terminal status of a STREAMED request once its harvest
+        delivered through the stream queue (answers never reach
+        ``_answers`` there), else None — the supervisor's stream-side
+        counterpart of ``try_result``."""
+        with self._lock:
+            st = self._status.get(rid)
+            if st is not None and rid not in self._streams:
+                return st
+        return None
+
+    def kill(self) -> list:
+        """Simulated replica death (the fleet chaos contract): deliver
+        anything already finished, then strip EVERY unfinished request
+        out of the batcher (``ContinuousBatcher.export_requests``) and
+        return the re-admission records — the supervisor re-routes them
+        to survivors. The scheduler loop parks and submits are refused
+        until ``revive()``. Engine-side waiter state for the exported
+        rids is dropped: the fleet owns those clients now."""
+        with self._lock:
+            self._dead = True
+            # Finished-but-uncollected answers are real results — hand
+            # them to try_result instead of re-running them elsewhere.
+            self._push_stream_deltas()
+            self._harvest()
+            recs = self.batcher.export_requests()
+            for rec in recs:
+                rid = rec["rid"]
+                self._done.pop(rid, None)
+                self._streams.pop(rid, None)
+                self._sent.pop(rid, None)
+                self._abandoned.discard(rid)
+            self._snapshot = self._build_snapshot()
+        self._wake.set()
+        return recs
+
+    def revive(self) -> None:
+        """Recovery half of ``kill``: the replica re-enters service with
+        a clean slate (the kill already swept the batcher) and a closed
+        breaker."""
+        with self._lock:
+            self._dead = False
+            self._consec_faults = 0
+            self.fault = None
+            self._snapshot = self._build_snapshot()
+        self._wake.set()
+
+    @property
+    def alive(self) -> bool:
+        return not self._dead
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The lock-free stats snapshot (staleness bounded by one
+        scheduler step) — the fleet supervisor's cheap health/load
+        read."""
+        return self._snapshot
+
+    def goodput_ratio(self) -> float:
+        """Windowed SLO-attainment of this engine, 1.0 until the window
+        holds anything (an empty window is no evidence of overload) —
+        the 429 Retry-After derivation reads this."""
+        slo = self._snapshot.get("slo", {})
+        if not slo.get("window_n"):
+            return 1.0
+        return float(slo.get("goodput_ratio", 1.0))
 
     def stream_queue(self, rid: int) -> queue.Queue:
         """Per-request queue of cumulative token-id lists. Two sentinels:
@@ -298,8 +396,13 @@ class ServingEngine:
             try:
                 faults.maybe_fail("serve.loop")
                 with self._lock:
-                    busy = (self.batcher.queue
-                            or any(r is not None for r in self.batcher.rows))
+                    # A killed replica parks: the fleet drained its work
+                    # and will revive() it (or not) — stepping a swept
+                    # batcher would be harmless but dishonest health.
+                    busy = (not self._dead
+                            and (self.batcher.queue
+                                 or any(r is not None
+                                        for r in self.batcher.rows)))
                     if busy:
                         self.batcher.step()
                         self._push_stream_deltas()
@@ -554,6 +657,11 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                                  "restarts": engine.n_restarts})
             elif self.path == "/stats":
                 self._json(200, engine.stats())
+            elif self.path == "/fleet" and hasattr(engine, "fleet_stats"):
+                # Fleet topology + routing/shedding policy + per-replica
+                # health (ISSUE 7) — only mounted when the engine IS a
+                # fleet router (cli fleet mode).
+                self._json(200, engine.fleet_stats())
             elif self.path == "/prefix_cache":
                 # Prefix-KV cache snapshot (ISSUE 4): entry list, byte
                 # budget/usage, hit/miss/eviction counters. Lock-free
@@ -676,6 +784,7 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                                  "entries": st.get("n_entries", 0),
                                  "bytes": st.get("bytes", 0)})
                 return
+            from eventgpt_tpu.fleet import FleetShedError, retry_after_s
             from eventgpt_tpu.serve import QueueFullError
 
             try:
@@ -713,13 +822,27 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
             try:
                 rid = engine.submit(query, pixels, budget, stream=stream,
                                     deadline_s=deadline, slo=slo)
-            except QueueFullError as e:
-                # Backpressure, not failure: tell the client to come back
-                # (bounded admission queue — ISSUE 1 tentpole).
-                body = json.dumps({"error": str(e)}).encode()
+            except (QueueFullError, FleetShedError) as e:
+                # Backpressure, not failure: tell the client to come
+                # back (bounded admission queue — ISSUE 1; fleet shed —
+                # ISSUE 7). Retry-After is CLASS-AWARE and derived from
+                # the current goodput window (fleet.retry_after_s), not
+                # a fixed constant: batch traffic backs off harder, and
+                # both classes back off longer the further attainment
+                # has sunk. A shed carries its hint on the exception;
+                # queue-full derives it here from the engine's window.
+                cls_name = slo.name if slo is not None else "batch"
+                ra = getattr(e, "retry_after_s", None)
+                if ra is None:
+                    ra = retry_after_s(cls_name, engine.goodput_ratio())
+                body = json.dumps({
+                    "error": str(e),
+                    "slo_class": cls_name,
+                    "retry_after_s": round(ra, 3),
+                }).encode()
                 self.send_response(429)
                 self.send_header("Content-Type", "application/json")
-                self.send_header("Retry-After", "1")
+                self.send_header("Retry-After", str(max(1, math.ceil(ra))))
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -891,38 +1014,76 @@ def build_server(args) -> tuple:
         # Arm fault injection from the CLI (EGPT_FAULTS works too): chaos
         # drills against a live server use the same spec grammar as tests.
         faults.configure(getattr(args, "faults"))
-    batcher = ContinuousBatcher(
-        params, cfg, max_batch=args.max_batch, max_len=args.max_len,
-        chunk=args.chunk, temperature=args.temperature,
-        eos_token_id=getattr(tokenizer, "eos_token_id", None),
-        kv_quant=args.kv_cache == "int8", speculative=args.speculative,
-        mesh=mesh, prefill_chunk=args.prefill_chunk,
-        draft_head=draft_head,
-        first_chunk=getattr(args, "first_chunk", 0),
-        max_queue=getattr(args, "max_queue", 0),
-        pipeline=not getattr(args, "no_pipeline", False),
-        prefix_cache=not getattr(args, "no_prefix_cache", False),
-        prefix_cache_bytes=int(
-            getattr(args, "prefix_cache_mb", 512.0) * 1024 * 1024),
-        # Stall-free admission (ISSUE 5): -1 = auto (one segment's worth
-        # of prompt tokens per boundary), 0 = off (exclusive waves).
-        prefill_budget=(args.chunk
-                        if getattr(args, "prefill_budget", -1) < 0
-                        else int(args.prefill_budget)),
-        slo_window=int(getattr(args, "slo_window", 256)),
-    )
-    if args.warmup:
-        t0 = time.perf_counter()
-        n = batcher.warmup()
-        print(f"[serve] warmup: {n} executables in "
-              f"{time.perf_counter() - t0:.1f}s")
-    engine = ServingEngine(
-        batcher, tokenizer, args.conv_mode,
-        breaker_threshold=getattr(args, "breaker_threshold", 3),
-        breaker_cooldown_s=getattr(args, "breaker_cooldown_s", 5.0),
-        heartbeat_dir=getattr(args, "heartbeat_dir", None),
-        trace_out=getattr(args, "trace_out", None),
-    )
+
+    def _make_batcher():
+        return ContinuousBatcher(
+            params, cfg, max_batch=args.max_batch, max_len=args.max_len,
+            chunk=args.chunk, temperature=args.temperature,
+            eos_token_id=getattr(tokenizer, "eos_token_id", None),
+            kv_quant=args.kv_cache == "int8", speculative=args.speculative,
+            mesh=mesh, prefill_chunk=args.prefill_chunk,
+            draft_head=draft_head,
+            first_chunk=getattr(args, "first_chunk", 0),
+            max_queue=getattr(args, "max_queue", 0),
+            pipeline=not getattr(args, "no_pipeline", False),
+            prefix_cache=not getattr(args, "no_prefix_cache", False),
+            prefix_cache_bytes=int(
+                getattr(args, "prefix_cache_mb", 512.0) * 1024 * 1024),
+            # Stall-free admission (ISSUE 5): -1 = auto (one segment's
+            # worth of prompt tokens per boundary), 0 = off (waves).
+            prefill_budget=(args.chunk
+                            if getattr(args, "prefill_budget", -1) < 0
+                            else int(args.prefill_budget)),
+            slo_window=int(getattr(args, "slo_window", 256)),
+        )
+
+    def _make_engine(batcher, hb_dir):
+        return ServingEngine(
+            batcher, tokenizer, args.conv_mode,
+            breaker_threshold=getattr(args, "breaker_threshold", 3),
+            breaker_cooldown_s=getattr(args, "breaker_cooldown_s", 5.0),
+            heartbeat_dir=hb_dir,
+            trace_out=getattr(args, "trace_out", None),
+        )
+
+    n_fleet = int(getattr(args, "fleet", 0) or 0)
+    hb_root = getattr(args, "heartbeat_dir", None)
+    if n_fleet > 1:
+        # Fleet mode (ISSUE 7): N in-process replicas (one weight tree,
+        # N resident caches/schedulers — the jit cache shares their
+        # executables) behind the prefix-affinity router. The handler
+        # serves the router through the same engine surface.
+        import os as _os
+
+        from eventgpt_tpu.fleet import Fleet
+
+        batchers = [_make_batcher() for _ in range(n_fleet)]
+        if args.warmup:
+            t0 = time.perf_counter()
+            n = sum(b.warmup() for b in batchers)
+            print(f"[serve] warmup: {n} executables in "
+                  f"{time.perf_counter() - t0:.1f}s")
+        engines = [
+            _make_engine(b, _os.path.join(hb_root, f"replica{i}")
+                         if hb_root else None)
+            for i, b in enumerate(batchers)
+        ]
+        engine = Fleet(
+            engines, tokenizer, args.conv_mode,
+            probe_interval_s=getattr(args, "fleet_probe_interval_s", 0.05),
+            heartbeat_stale_s=getattr(args, "fleet_heartbeat_stale_s", 5.0),
+            shed_goodput_ratio=getattr(args, "fleet_shed_goodput", 0.5),
+            shed_queue_depth=getattr(args, "fleet_shed_queue", 0),
+            replica_restart_s=getattr(args, "fleet_restart_s", 0) or None,
+        )
+    else:
+        batcher = _make_batcher()
+        if args.warmup:
+            t0 = time.perf_counter()
+            n = batcher.warmup()
+            print(f"[serve] warmup: {n} executables in "
+                  f"{time.perf_counter() - t0:.1f}s")
+        engine = _make_engine(batcher, hb_root)
     if getattr(args, "prefix_prompt", None):
         # Startup form of POST /prefix: cache the shared prompt head's KV
         # once, before traffic. --prefix_event supplies the stream when
@@ -1048,6 +1209,29 @@ def main(argv=None):
     p.add_argument("--heartbeat_dir", default=None,
                    help="directory for the serving heartbeat.json "
                         "(train/resilience.py format; unset = disabled)")
+    # -- fleet serving (ISSUE 7; DISTRIBUTED.md "Fleet serving") --
+    p.add_argument("--fleet", type=int, default=0,
+                   help="run N ServingEngine replicas behind the "
+                        "prefix-affinity router (0/1 = single engine). "
+                        "Replicas share the weight tree; each owns its "
+                        "resident KV cache and scheduler thread")
+    p.add_argument("--fleet_shed_goodput", type=float, default=0.5,
+                   help="shed batch-class requests while the aggregate "
+                        "windowed goodput ratio is below this "
+                        "(0 disarms the goodput signal)")
+    p.add_argument("--fleet_shed_queue", type=int, default=0,
+                   help="shed batch-class requests while the aggregate "
+                        "queued-request count is at/above this "
+                        "(0 disarms the queue-depth signal)")
+    p.add_argument("--fleet_probe_interval_s", type=float, default=0.05,
+                   help="supervisor health-probe / collection period")
+    p.add_argument("--fleet_heartbeat_stale_s", type=float, default=5.0,
+                   help="replica heartbeat age that marks it unroutable "
+                        "(fleet mode writes per-replica heartbeats under "
+                        "--heartbeat_dir/replicaN)")
+    p.add_argument("--fleet_restart_s", type=float, default=0.0,
+                   help="auto-revive a killed replica after this many "
+                        "seconds (0 = operator restart only)")
     # -- SLO classes + goodput (ISSUE 6; OBSERVABILITY.md) --
     p.add_argument("--slo_interactive_ttft_s", type=float, default=1.0,
                    help="interactive-class TTFT target scored at finish "

@@ -42,7 +42,12 @@ survive; ``serve.mixed_dispatch`` fires at the piggyback lane-advance
 boundary of a mixed segment — the batcher degrades that boundary to a
 plain decode dispatch and re-queues the admitting lanes, decode rows
 untouched), ``serve.prefix_copy`` (prefix-cache entry copy at admission),
-``serve.loop`` (``ServingEngine`` scheduler thread), ``multiproc.launch``
+``serve.loop`` (``ServingEngine`` scheduler thread), ``fleet.route`` /
+``fleet.probe`` / ``fleet.replica_kill`` (``fleet.Fleet``: a route fault
+degrades that submit to least-queue routing, a probe fault marks the
+probed replica unroutable until a clean probe, and a replica_kill trip
+IS the scripted chaos kill — the supervisor kills a live replica and
+must drain + re-route its requests to survivors), ``multiproc.launch``
 / ``multiproc.worker`` (``parallel/multiproc.py`` bootstrap), and
 ``train.step`` (``Trainer`` micro-batch boundary).
 

@@ -69,10 +69,21 @@ METRIC_LABELS = {
         # cross-checks this tuple against rule 4's site scan, so a new
         # site cannot ship without extending the enum); "other" absorbs
         # synthetic/ad-hoc drill sites (faults._site_label clamps).
-        "site": ("multiproc.launch", "multiproc.worker", "serve.admit",
+        "site": ("fleet.probe", "fleet.replica_kill", "fleet.route",
+                 "multiproc.launch", "multiproc.worker", "serve.admit",
                  "serve.dispatch", "serve.loop", "serve.mixed_dispatch",
                  "serve.prefix_copy", "serve.step", "train.step", "other"),
         "kind": ("fail", "delay"),
+    },
+    "egpt_fleet_routed_total": {
+        # Routing decisions (ISSUE 7): affinity = the session's pinned
+        # replica (its radix prefix is hot), least_queue = fallback by
+        # queue depth, repin = failover re-route that moved the
+        # session's pin to a survivor.
+        "reason": ("affinity", "least_queue", "repin"),
+    },
+    "egpt_fleet_shed_total": {
+        "slo_class": ("interactive", "batch"),
     },
     "egpt_serve_slo_requests_total": {
         "slo_class": ("interactive", "batch"),
@@ -524,6 +535,40 @@ SERVE_SLO_GOODPUT = REGISTRY.gauge(
     "egpt_serve_slo_goodput_ratio",
     "Fraction of the last slo_window SLO-classed finishes that met "
     "their targets (windowed SLO-attainment goodput)")
+
+# -- fleet serving: replica supervisor + router (ISSUE 7,
+#    eventgpt_tpu/fleet.py) --
+# Aggregate-only on purpose: a per-replica label would be computed
+# (str(idx) — lint rule 5 bans it); per-replica numbers live in the
+# fleet's /stats JSON and the bench artifact, read from each replica's
+# host-side counters.
+FLEET_REPLICAS = REGISTRY.gauge(
+    "egpt_fleet_replicas", "Configured replicas in the fleet")
+FLEET_ROUTABLE = REGISTRY.gauge(
+    "egpt_fleet_replicas_routable",
+    "Replicas currently in the routing pool (healthy: breaker closed, "
+    "heartbeat fresh, not killed)")
+FLEET_QUEUE_DEPTH = REGISTRY.gauge(
+    "egpt_fleet_queue_depth",
+    "Requests queued across every replica (the router's aggregate "
+    "backlog — one of the two shedding signals)")
+FLEET_ROUTED = REGISTRY.counter(
+    "egpt_fleet_routed_total",
+    "Routed submits by decision: affinity (session's pinned replica), "
+    "least_queue (fallback), repin (failover moved the pin)")
+FLEET_SHED = REGISTRY.counter(
+    "egpt_fleet_shed_total",
+    "Requests shed by the router's SLO-aware overload policy, by class "
+    "(batch sheds first; interactive is never policy-shed)")
+FLEET_FAILOVERS = REGISTRY.counter(
+    "egpt_fleet_failovers_total",
+    "Requests re-routed to a surviving replica after their replica "
+    "died or faulted them (re-decoded from the prompt: greedy chains "
+    "stay byte-identical)")
+FLEET_REPLICA_DEATHS = REGISTRY.counter(
+    "egpt_fleet_replica_deaths_total",
+    "Replica kills observed by the supervisor (chaos fleet.replica_kill "
+    "trips and operator kill_replica calls)")
 
 # -- fault injection (eventgpt_tpu/faults.py) --
 FAULT_TRIPS = REGISTRY.counter(

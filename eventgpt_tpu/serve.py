@@ -2057,6 +2057,70 @@ class ContinuousBatcher:
                 return True
         return False
 
+    def export_requests(self) -> List[Dict[str, Any]]:
+        """Drain hook (ISSUE 7): settle the pipeline, then strip EVERY
+        unfinished request — active rows, piggyback lanes, the pending
+        chunked admission, the queue — out of the scheduler and return
+        their re-admission records, in submission order. The fleet
+        supervisor re-routes these to surviving replicas when a replica
+        dies (``ServingEngine.kill``).
+
+        Committed tokens are DISCARDED on purpose: failover re-decodes
+        from the prompt, and greedy chains are deterministic per request
+        (rows are independent in attention), so the survivor's chain is
+        byte-identical to an uninterrupted run. Deadlines export as the
+        REMAINING headroom (absolute perf_counter deadlines do not
+        transfer between submit calls). Nothing reaches ``finished`` /
+        ``finish_status`` — the request is not over, it is moving."""
+        self._drain()
+        by_rid: Dict[int, _Request] = {}
+        for req in self.queue:
+            by_rid[req.rid] = req
+        self.queue.clear()
+        if self._pending is not None:
+            p, self._pending = self._pending, None
+            self.rows[p.row] = None  # row stays frozen; cache untouched
+            by_rid[p.req.rid] = p.req
+        for l in self._lanes:
+            self.rows[l.row] = None  # lane KV is dead storage
+            by_rid[l.req.rid] = l.req
+        self._lanes = []
+        self._lane_free = list(range(self._lane_cap))
+        for r, req in enumerate(self.rows):
+            if req is None:
+                continue
+            self.rows[r] = None
+            self.frozen[r] = True
+            self.n_rem[r] = 0
+            by_rid[req.rid] = req
+        # The host mirror changed under the device carry: rebuild at the
+        # next dispatch (same rule as every external forced finish).
+        self._dev_carry = None
+        now = time.perf_counter()
+        out: List[Dict[str, Any]] = []
+        for rid in sorted(by_rid):
+            req = by_rid[rid]
+            if req.prefix_entry is not None:
+                # Same pin-drain rule as _record_finish: the entry must
+                # not stay unevictable behind a request that left.
+                req.prefix_entry.pins -= 1
+                req.prefix_entry = None
+            if req.deadline is not None:
+                self._n_deadlines -= 1
+            obs_trace.async_end(req.phase, req.rid, status="exported")
+            out.append({
+                "rid": req.rid,
+                "input_ids": list(req.input_ids),
+                "pixel_values": req.pixel_values,
+                "max_new_tokens": req.max_new_tokens,
+                "deadline_s": (req.deadline - now
+                               if req.deadline is not None else None),
+                "slo": req.slo,
+            })
+        obs_metrics.SERVE_QUEUE_DEPTH.set(0)
+        obs_metrics.SERVE_ACTIVE_ROWS.set(0)
+        return out
+
     def run_until_drained(self) -> Dict[int, List[int]]:
         while self.queue or any(r is not None for r in self.rows):
             self.step()

@@ -71,6 +71,56 @@ def test_workload_artifacts_schema():
             f"the <2% contract"
 
 
+def test_fleet_workload_artifact_schema():
+    """ISSUE 7 acceptance shape: >= 2 replicas, >= 2 offered-load
+    points, and per-replica goodput / hit-ratio / failover counts in
+    every sweep leg (the fleet-only keys OBSERVABILITY.md documents)."""
+    paths = sorted(glob.glob(os.path.join(ROOT, "WORKLOAD_FLEET_r0*.json")))
+    assert paths, "no WORKLOAD_FLEET_r0*.json checked in"
+    for p in paths:
+        rec = _load(p)
+        assert rec["metric"].startswith("workload_fleet_goodput_"), p
+        assert rec["fleet"] >= 2, f"{p}: need >= 2 replicas"
+        sweep = rec["sweep"]
+        assert len(sweep) >= 2, f"{p}: need >= 2 offered-load points"
+        for leg in sweep:
+            for k in ("rate_mult", "goodput_rps", "slo_met_ratio",
+                      "tok_s", "prefix_cache_hit_ratio", "classes",
+                      "shed_total", "rejected_total", "failovers",
+                      "replicas"):
+                assert k in leg, (p, k)
+            assert len(leg["classes"]) >= 2, \
+                f"{p}: need >= 2 SLO classes per point"
+            assert len(leg["replicas"]) == rec["fleet"], p
+            for rep in leg["replicas"]:
+                for k in ("replica", "requests", "goodput_rps",
+                          "slo_met_ratio", "prefix_cache_hit_ratio"):
+                    assert k in rep, (p, k)
+
+
+def test_compare_bench_gates_fleet_vs_single_workload():
+    """ISSUE 7 satellite: compare_bench is the tier-1 smoke gate over
+    the checked-in fleet artifact vs WORKLOAD_r01.json — direction-aware
+    keys only, pinned to the SLO-goodput keys (cross-topology tok_s /
+    latency pairing is skewed; OBSERVABILITY.md 'Fleet workload record'
+    documents the fleet-only keys that are never gated). Degrading the
+    fleet goodput must fire — the gate has teeth on these keys."""
+    mod = _compare_mod()
+    base = _load(os.path.join(ROOT, "WORKLOAD_r01.json"))
+    new = _load(sorted(glob.glob(
+        os.path.join(ROOT, "WORKLOAD_FLEET_r0*.json")))[0])
+    require = ("goodput_rps", "slo_met_ratio", "attainment",
+               "prefix_cache_hit_ratio")
+    regs, _ = mod.compare(base, new, require=require)
+    assert regs == [], f"fleet artifact regressed the SLO-goodput " \
+                       f"keys vs WORKLOAD_r01: {regs}"
+    worse = json.loads(json.dumps(new))
+    for leg in worse["sweep"]:
+        leg["goodput_rps"] *= 0.5
+    regs, _ = mod.compare(base, worse, require=require)
+    assert any("goodput_rps" in r for r in regs)
+
+
 def test_compare_bench_gates_checked_in_rounds():
     """Smoke the regression gate on two committed rounds: r04 -> r05 is
     a known-clean transition (it must pass), and the reverse direction

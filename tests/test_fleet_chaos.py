@@ -1,0 +1,272 @@
+"""Chaos tests for the fleet tier (ISSUE 7): every ``fleet.*`` fault
+site armed and survived (lint_telemetry rule 4), the scripted replica
+kill -> drain -> re-route -> recovery sequence with byte-identical
+greedy chains vs a single-engine run, and the class-aware Retry-After
+on BOTH 429 paths (queue-full and shed) over real HTTP."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from eventgpt_tpu import faults
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
+from eventgpt_tpu.fleet import Fleet, retry_after_s
+from eventgpt_tpu.models import eventchat
+from eventgpt_tpu.serve import ContinuousBatcher, QueueFullError
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.disable()
+    yield
+    faults.disable()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _pv(cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(cfg.num_event_frames, 3, cfg.vision.image_size,
+                            cfg.vision.image_size)).astype(np.float32)
+
+
+def _ids(suffix=()):
+    return [1, 7, 7, EVENT_TOKEN_INDEX, 9, 10, 11] + list(suffix)
+
+
+def _batcher(tiny, **kw):
+    cfg, params = tiny
+    kw.setdefault("max_batch", 1)
+    kw.setdefault("chunk", 2)
+    kw.setdefault("max_len", 256)
+    kw.setdefault("eos_token_id", None)
+    return ContinuousBatcher(params, cfg, **kw)
+
+
+def _fleet(tiny, n=2, probe_interval_s=0.01, **kw):
+    from eventgpt_tpu.cli.serve import ServingEngine
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+
+    tok = load_tokenizer("byte")
+    bkw = kw.pop("batcher_kw", {})
+    engines = [ServingEngine(_batcher(tiny, **bkw), tok) for _ in range(n)]
+    return Fleet(engines, tok, probe_interval_s=probe_interval_s, **kw)
+
+
+def _event_npy_b64(tmp_path, n=4000):
+    import base64
+
+    from eventgpt_tpu.ops.raster import STREAM_DTYPE
+
+    rng = np.random.default_rng(0)
+    arr = np.zeros(n, dtype=STREAM_DTYPE)
+    arr["x"] = rng.integers(0, 64, n)
+    arr["y"] = rng.integers(0, 48, n)
+    arr["t"] = np.sort(rng.integers(0, 50_000, n)).astype(np.uint64)
+    arr["p"] = rng.integers(0, 2, n)
+    path = os.path.join(str(tmp_path), "events.npy")
+    np.save(path, arr)
+    with open(path, "rb") as f:
+        return base64.b64encode(f.read()).decode()
+
+
+def _serve_http(engine, cfg):
+    from http.server import ThreadingHTTPServer
+
+    from eventgpt_tpu.cli.serve import make_handler
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                make_handler(engine, cfg))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url + "/v1/generate", json.dumps(payload).encode(),
+        {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_route_fault_degrades_to_least_queue(tiny):
+    """``fleet.route``: an affinity-table fault must cost LOCALITY, not
+    availability — the submit falls back to least-queue and succeeds."""
+    cfg, _ = tiny
+    fleet = _fleet(tiny)
+    try:
+        f0 = fleet.submit_ids(_ids(), _pv(cfg, 5), 4)
+        fleet.result(f0, timeout=120)  # establishes the session pin
+        faults.configure("fleet.route:n=1")
+        f1 = fleet.submit_ids(_ids((33,)), _pv(cfg, 5), 4)
+        assert len(fleet.result(f1, timeout=120)) == 4
+        assert fleet.n_route_faults == 1
+        assert faults.stats()["fleet.route"]["fires"] == 1
+    finally:
+        fleet.shutdown()
+
+
+def test_probe_fault_marks_replica_unroutable_then_recovers(tiny):
+    """``fleet.probe``: a failed health probe means health is UNKNOWN —
+    the replica leaves the routing pool until a clean probe readmits
+    it. Service never stops: the other replica keeps serving."""
+    cfg, _ = tiny
+    fleet = _fleet(tiny)
+    try:
+        faults.configure("fleet.probe:n=1")
+        deadline = time.time() + 30
+        while time.time() < deadline and not any(
+                r.state == "degraded" for r in fleet.replicas):
+            time.sleep(0.002)
+        assert any(r.probe_faults >= 1 for r in fleet.replicas)
+        # The degraded replica is skipped by routing but service holds.
+        f = fleet.submit_ids(_ids(), _pv(cfg, 6), 4)
+        assert len(fleet.result(f, timeout=120)) == 4
+        # n=1 fires once: the NEXT probe of that replica is clean and
+        # re-admits it.
+        deadline = time.time() + 30
+        while time.time() < deadline and not all(
+                r.state == "ok" for r in fleet.replicas):
+            time.sleep(0.002)
+        assert all(r.state == "ok" for r in fleet.replicas)
+    finally:
+        fleet.shutdown()
+
+
+def test_replica_kill_chaos_drain_reroute_recovery(tiny):
+    """THE acceptance chaos script: kill one of N replicas MID-DECODE
+    via the ``fleet.replica_kill`` site -> its queued + in-flight
+    requests drain and re-route to the survivor and finish with greedy
+    chains byte-identical to a single-engine run -> recovery re-admits
+    the replica to the routing pool."""
+    cfg, _ = tiny
+    reqs = [(_ids((80 + i,)), _pv(cfg, 400 + i), 20) for i in range(4)]
+    ref_b = _batcher(tiny, max_batch=2)
+    ref_rids = [ref_b.submit(ids, pv, n) for ids, pv, n in reqs]
+    ref = ref_b.run_until_drained()
+
+    fleet = _fleet(tiny, replica_restart_s=0.5)
+    try:
+        frids = [fleet.submit_ids(ids, pv, n) for ids, pv, n in reqs]
+        # Wait until a replica is decoding, then arm the scripted kill:
+        # the next supervisor tick takes down the busiest replica with
+        # work in flight.
+        deadline = time.time() + 30
+        while time.time() < deadline and not any(
+                any(r is not None for r in rep.engine.batcher.rows)
+                for rep in fleet.replicas):
+            time.sleep(0.002)
+        faults.configure("fleet.replica_kill:n=1")
+        deadline = time.time() + 30
+        while time.time() < deadline and fleet.n_kills == 0:
+            time.sleep(0.002)
+        assert fleet.n_kills == 1, "scripted kill never fired"
+        dead = [r.idx for r in fleet.replicas if r.state == "dead"]
+        out = [fleet.result(f, timeout=120) for f in frids]
+        # Byte-identical failover: every chain equals the uninterrupted
+        # single-engine run, whatever was mid-decode at the kill.
+        assert out == [ref[r] for r in ref_rids]
+        assert fleet.n_failovers >= 1
+        assert faults.stats()["fleet.replica_kill"]["fires"] == 1
+        # Recovery: replica_restart_s auto-revives the dead replica and
+        # re-admits it to the routing pool.
+        deadline = time.time() + 30
+        while time.time() < deadline and not all(
+                r.state == "ok" for r in fleet.replicas):
+            time.sleep(0.01)
+        assert all(r.state == "ok" for r in fleet.replicas), \
+            f"replica {dead} never recovered"
+        f = fleet.submit_ids(_ids((99,)), _pv(cfg, 500), 4)
+        assert len(fleet.result(f, timeout=120)) == 4
+    finally:
+        fleet.shutdown()
+
+
+def test_http_queue_full_429_retry_after_is_class_aware(tiny, tmp_path):
+    """Satellite: the queue-full 429's Retry-After derives from the
+    goodput window per class — batch is told to back off harder than
+    interactive (no more fixed '1')."""
+    from eventgpt_tpu.cli.serve import ServingEngine
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+
+    cfg, _ = tiny
+    eng = ServingEngine(_batcher(tiny, max_queue=4),
+                        load_tokenizer("byte"))
+    httpd, url = _serve_http(eng, cfg)
+
+    def full(*a, **kw):
+        raise QueueFullError("admission queue is full (4/4)")
+
+    try:
+        eng.batcher.submit = full
+        b64 = _event_npy_b64(tmp_path)
+        headers = {}
+        for cls in ("interactive", "batch"):
+            req = urllib.request.Request(
+                url + "/v1/generate",
+                json.dumps({"query": "busy?", "event_b64": b64,
+                            "slo_class": cls}).encode(),
+                {"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=60)
+            assert e.value.code == 429
+            headers[cls] = int(e.value.headers.get("Retry-After"))
+            body = json.loads(e.value.read())
+            assert body["slo_class"] == cls
+            assert body["retry_after_s"] == pytest.approx(
+                retry_after_s(cls, 1.0), rel=0.01)
+        assert headers["batch"] > headers["interactive"] >= 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        eng.shutdown()
+
+
+def test_http_shed_429_retry_after_from_fleet_goodput(tiny, tmp_path):
+    """Satellite, shed path: a fleet policy shed surfaces as 429 with
+    the hint the FleetShedError carried (fleet-goodput derived)."""
+    cfg, _ = tiny
+    fleet = _fleet(tiny)
+    httpd, url = _serve_http(fleet, cfg)
+    try:
+        fleet._overloaded = lambda: (True, "forced by test")
+        b64 = _event_npy_b64(tmp_path)
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            json.dumps({"query": "shed me", "event_b64": b64,
+                        "slo_class": "batch"}).encode(),
+            {"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=60)
+        assert e.value.code == 429
+        assert int(e.value.headers.get("Retry-After")) >= 1
+        body = json.loads(e.value.read())
+        assert "shed" in body["error"]
+        assert body["slo_class"] == "batch"
+        # Interactive is protected: same overload, it is served.
+        fleet._overloaded = lambda: (True, "forced by test")
+        out = _post(url, {"query": "keep me", "event_b64": b64,
+                          "slo_class": "interactive",
+                          "max_new_tokens": 4})
+        assert out["status"] == "ok" and out["tokens"] == 4
+        # /fleet exposes the shed count + topology.
+        with urllib.request.urlopen(url + "/fleet", timeout=30) as r:
+            fl = json.loads(r.read())
+        assert fl["replicas"] == 2 and fl["shed"].get("batch", 0) >= 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        fleet.shutdown()
