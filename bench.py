@@ -852,6 +852,14 @@ def run_workload(args):
         "rate_rps": spec.rate_rps,
         "sessions": spec.sessions,
         "seed": spec.seed,
+        # Output-cap flags (ISSUE 8 satellite): tok_s is only pairable
+        # across records generated from the SAME trace shape — r01 shipped
+        # without these, so compare_bench had to skip tok_s across
+        # topologies. trace_output_tokens is the audit number (the sum of
+        # budgets an eos-free replay serves exactly).
+        "output_min": spec.output_min,
+        "output_max": spec.output_max,
+        "trace_output_tokens": sum(r.max_new_tokens for r in trace),
         "slo": {
             "interactive": {"ttft_s": spec.interactive_ttft_s,
                             "itl_s": spec.interactive_itl_s},
@@ -1077,6 +1085,11 @@ def _run_workload_fleet(args, preset, cfg, platform, params, spec, trace):
         "rate_rps": spec.rate_rps,
         "sessions": spec.sessions,
         "seed": spec.seed,
+        # Same output-cap identity keys as the single-engine record, so
+        # compare_bench can pair tok_s across topologies (ISSUE 8).
+        "output_min": spec.output_min,
+        "output_max": spec.output_max,
+        "trace_output_tokens": sum(r.max_new_tokens for r in trace),
         "slo": {
             "interactive": {"ttft_s": spec.interactive_ttft_s,
                             "itl_s": spec.interactive_itl_s},

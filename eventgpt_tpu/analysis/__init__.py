@@ -1,0 +1,31 @@
+"""egpt-check: the repo's unified static-analysis suite (ISSUE 8).
+
+``scripts/egpt_check.py`` is the runner; ``ALL_RULES`` is the
+registry — three analyzers born in this PR (lock-discipline race
+detector, host-sync hot-path lint, jit-hygiene lint) plus the five
+telemetry rules migrated from ``scripts/lint_telemetry.py``. The shared
+walk, the ``Finding`` shape, and the waiver grammar live in ``core``.
+
+Deliberately jax-free and stdlib-only: the suite must run (and the fast
+tier must gate on it) anywhere the repo checks out, before any device
+exists.
+"""
+
+from eventgpt_tpu.analysis.core import (Context, Finding, Rule,
+                                        load_sources, render_json,
+                                        render_text, run_checks,
+                                        unwaived)
+from eventgpt_tpu.analysis.hot_path import HotSyncRule
+from eventgpt_tpu.analysis.jit_hygiene import JitHygieneRule
+from eventgpt_tpu.analysis.lock_discipline import LockDisciplineRule
+from eventgpt_tpu.analysis.telemetry_rules import TELEMETRY_RULES
+
+ALL_RULES = (LockDisciplineRule(), HotSyncRule(),
+             JitHygieneRule()) + TELEMETRY_RULES
+
+__all__ = [
+    "ALL_RULES", "Context", "Finding", "Rule", "load_sources",
+    "render_json", "render_text", "run_checks", "unwaived",
+    "HotSyncRule", "JitHygieneRule", "LockDisciplineRule",
+    "TELEMETRY_RULES",
+]

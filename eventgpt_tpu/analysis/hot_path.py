@@ -1,0 +1,213 @@
+"""Host-sync hot-path lint (ISSUE 8 tentpole, rule ``hot-sync``).
+
+The pipelined scheduler's overlap win (PR 2: segment N+1 dispatches from
+device-resident state while the host harvests segment N) survives only
+as long as nothing on the dispatch path forces an early device sync. A
+single stray ``.item()`` or ``np.asarray(device_array)`` quietly
+re-serializes the whole pipeline — throughput regresses with no error
+anywhere. This rule is the static guarantee behind the measured overlap
+ratio:
+
+A class (or module) DECLARES its dispatch-path roots::
+
+    _HOT_ROOTS = ("step", "_dispatch_segment")
+
+The analyzer computes the functions reachable from those roots — via
+``self.method()`` calls, direct module-function calls, and module-level
+aliases (``_decode_segment_jit -> _decode_segment``) — and flags, in
+every reachable function, the host-sync shapes:
+
+  * ``.item()`` — scalar readback, a full device sync;
+  * ``jax.device_get(...)`` / ``.block_until_ready()`` — explicit syncs;
+  * ``np.asarray`` / ``np.array`` / ``np.ascontiguousarray`` on
+    anything that is not a provable host container (list/tuple literal
+    or comprehension) — a device array argument devolves to device_get;
+  * ``float(x)`` where ``x`` is a call/subscript/attribute expression —
+    the implicit scalar readback shape.
+
+Harvest points are ANNOTATED, not inferred: a ``def`` carrying
+``# egpt-check: harvest -- reason`` (on the def line or the line above)
+is where the design says the host blocks (``_harvest_segment`` fetching
+a settled segment; the admission NaN-quarantine readbacks). Annotated
+functions are exempt and the reachability walk stops there — everything
+downstream runs on already-harvested host state.
+
+Static limits: the walk is per-file (cross-module calls are attribute
+calls it does not follow) and jitted bodies reached by alias ARE walked
+— a host sync inside a traced function would be a trace-time sync,
+which is just as wrong.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from eventgpt_tpu.analysis.core import (Context, Finding, Rule,
+                                        class_literal, is_harvest)
+
+HOT_ROOTS_ATTR = "_HOT_ROOTS"
+
+_NP_NAMES = ("np", "numpy")
+_NP_SYNC_FNS = ("asarray", "array", "ascontiguousarray")
+_HOST_ARG_NODES = (ast.List, ast.ListComp, ast.Tuple, ast.Constant,
+                   ast.Dict, ast.GeneratorExp)
+
+
+def _callee_name(call: ast.Call) -> Optional[str]:
+    """'self.m' for method calls, 'f' for direct calls, else None."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)
+            and fn.value.id == "self"):
+        return f"self.{fn.attr}"
+    return None
+
+
+def _module_aliases(tree: ast.AST,
+                    functions: Dict[str, ast.AST]) -> Dict[str, str]:
+    """Module-level ``A = <expr referencing function F>`` -> {A: F}:
+    how ``_decode_segment_jit = functools.partial(jax.jit, ...)
+    (_decode_segment)`` resolves back to the wrapped body."""
+    out: Dict[str, str] = {}
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        refs = [n.id for n in ast.walk(node.value)
+                if isinstance(n, ast.Name) and n.id in functions]
+        if len(refs) == 1:
+            out[node.targets[0].id] = refs[0]
+    return out
+
+
+class HotSyncRule(Rule):
+    id = "hot-sync"
+    doc = ("functions reachable from the declared dispatch-path roots "
+           "(_HOT_ROOTS) contain no host syncs except at annotated "
+           "harvest points")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        for s in ctx.sources:
+            if s.tree is None:
+                continue
+            self._check_module(s, findings)
+        return findings
+
+    # -- per-module walk --------------------------------------------------
+
+    def _check_module(self, s, findings: List[Finding]) -> None:
+        module_fns: Dict[str, ast.AST] = {
+            n.name: n for n in s.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        aliases = _module_aliases(s.tree, module_fns)
+        classes = [n for n in ast.walk(s.tree)
+                   if isinstance(n, ast.ClassDef)]
+        # Module-level roots, then per-class roots.
+        declared = False
+        for cls in classes:
+            try:
+                roots, line = class_literal(cls, HOT_ROOTS_ATTR)
+            except ValueError as e:
+                findings.append(Finding(
+                    self.id, s.rel, cls.lineno, f"{cls.name}: {e}"))
+                continue
+            if roots is None:
+                continue
+            declared = True
+            if not isinstance(roots, (tuple, list)) or not all(
+                    isinstance(r, str) for r in roots):
+                findings.append(Finding(
+                    self.id, s.rel, line,
+                    f"{cls.name}: {HOT_ROOTS_ATTR} must be a tuple of "
+                    f"method/function names"))
+                continue
+            methods = {n.name: n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            missing = [r for r in roots
+                       if r not in methods and r not in module_fns
+                       and r not in aliases]
+            for r in missing:
+                findings.append(Finding(
+                    self.id, s.rel, line,
+                    f"{cls.name}: {HOT_ROOTS_ATTR} names unknown "
+                    f"function {r!r}"))
+            self._walk_hot_set(
+                s, [r for r in roots if r not in missing],
+                methods, module_fns, aliases, findings)
+        del declared
+
+    def _walk_hot_set(self, s, roots, methods, module_fns, aliases,
+                      findings: List[Finding]) -> None:
+        # key space: "self.<name>" for methods, "<name>" for module fns.
+        def resolve(name: str):
+            if name.startswith("self."):
+                return methods.get(name[5:]), name
+            if name in module_fns:
+                return module_fns[name], name
+            if name in aliases:
+                return module_fns.get(aliases[name]), aliases[name]
+            return None, name
+
+        seen: Set[str] = set()
+        queue: List[str] = []
+        for r in roots:
+            queue.append(f"self.{r}" if r in methods else r)
+        while queue:
+            name = queue.pop()
+            fn, key = resolve(name)
+            if fn is None or key in seen:
+                continue
+            seen.add(key)
+            harvest, _reason = is_harvest(s, fn)
+            if harvest:
+                continue  # annotated sync point: exempt, walk stops
+            self._check_hot_fn(s, fn, key, findings)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    callee = _callee_name(node)
+                    if callee is not None:
+                        queue.append(callee)
+
+    # -- banned shapes ----------------------------------------------------
+
+    def _check_hot_fn(self, s, fn, key: str,
+                      findings: List[Finding]) -> None:
+        where = key.replace("self.", "")
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            msg = None
+            if isinstance(f, ast.Attribute):
+                if f.attr == "item" and not node.args:
+                    msg = ".item() is a full device sync"
+                elif f.attr == "device_get":
+                    msg = "jax.device_get forces a host readback"
+                elif f.attr == "block_until_ready":
+                    msg = "block_until_ready stalls the dispatch path"
+                elif (f.attr in _NP_SYNC_FNS
+                      and isinstance(f.value, ast.Name)
+                      and f.value.id in _NP_NAMES
+                      and not (node.args and isinstance(
+                          node.args[0], _HOST_ARG_NODES))):
+                    msg = (f"np.{f.attr} on a possibly device-resident "
+                           f"value devolves to device_get")
+            elif (isinstance(f, ast.Name) and f.id == "float"
+                  and len(node.args) == 1
+                  and isinstance(node.args[0],
+                                 (ast.Call, ast.Subscript))):
+                msg = ("float(<array expr>) is an implicit scalar "
+                       "readback")
+            if msg is not None:
+                findings.append(Finding(
+                    self.id, s.rel, node.lineno,
+                    f"host sync in dispatch-path function "
+                    f"'{where}': {msg}",
+                    hint="move it behind an annotated harvest point "
+                         "('# egpt-check: harvest -- reason' on the "
+                         "def) or waive with justification"))

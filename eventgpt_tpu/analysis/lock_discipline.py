@@ -1,0 +1,319 @@
+"""Lock-discipline race detector (ISSUE 8 tentpole, rule ``lock``).
+
+The serving stack is multi-threaded (HTTP handler threads + per-replica
+scheduler threads + the fleet supervisor probe loop), and until this PR
+every lock convention — ``with self._lock`` around shared dicts,
+``*_locked`` helper methods, lock-free snapshot reads — lived only in
+docstrings. This rule makes the conventions checkable:
+
+A class DECLARES its guarded attributes::
+
+    _GUARDED_BY = {
+        "_requests": "_lock",      # reads AND writes under the lock
+        "_snapshot": "_lock/w",    # writes under the lock; reads are
+    }                              # lock-free by design (snapshot pattern)
+
+and the analyzer verifies, method by method:
+
+  * every read/write of a guarded attribute (``self._requests[...]``,
+    ``self._snapshot = ...``) happens inside a ``with self._lock:``
+    block, inside ``__init__`` (construction precedes sharing), or
+    inside a ``*_locked`` method — the repo's "caller holds the lock"
+    naming convention;
+  * ``*_locked`` methods are only CALLED from lock scope (a ``with``
+    block, another ``*_locked`` method, or ``__init__``) — and never
+    re-take the lock they assert (``threading.Lock`` is non-reentrant:
+    that is a deadlock, not a style issue);
+  * every lock named by the declaration is actually created in
+    ``__init__``;
+  * ``/w`` ("writes-only") encodes the deliberate lock-free-read
+    contract (GIL-atomic snapshot/flag reads) so it is visible at the
+    declaration instead of silently assumed per call site.
+
+``_EXTERNAL_LOCK = "Owner._lock"`` declares a class that holds shared
+mutable state but is serialized ENTIRELY by its owner's lock
+(``ContinuousBatcher`` under ``ServingEngine._lock``): the analyzer then
+verifies the class manufactures no concurrency of its own — no
+``threading.Thread(...)`` and no ``threading.Lock()`` stored on self —
+so the external-serialization claim stays true.
+
+Known static limits (documented, not silent): accesses through OTHER
+objects (``engine.batcher.queue`` from a module function) and attributes
+not listed in ``_GUARDED_BY`` are out of scope; nested functions are
+analyzed as lock-NOT-held (a closure may escape the lock scope it was
+built in). Deliberate benign races carry a waiver (the core grammar:
+``egpt-check: ignore[<rule>] -- <reason>`` in a trailing comment).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from eventgpt_tpu.analysis.core import (Context, Finding, Rule,
+                                        class_literal)
+
+GUARDED_ATTR = "_GUARDED_BY"
+EXTERNAL_ATTR = "_EXTERNAL_LOCK"
+
+
+def _parse_spec(spec: str) -> Tuple[str, bool]:
+    """'LOCK' -> (lock, reads_guarded=True); 'LOCK/w' -> (lock, False)."""
+    if spec.endswith("/w"):
+        return spec[:-2], False
+    return spec, True
+
+
+def _is_self_attr(node: ast.AST, attr: Optional[str] = None) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and (attr is None or node.attr == attr))
+
+
+def _with_locks(node: ast.With) -> Set[str]:
+    """Lock names this ``with`` acquires via ``self.<name>``."""
+    out: Set[str] = set()
+    for item in node.items:
+        if _is_self_attr(item.context_expr):
+            out.add(item.context_expr.attr)
+    return out
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Walks one method tracking which ``self.<lock>`` locks are held.
+    Records guarded-attribute accesses and ``*_locked`` calls that
+    happen outside lock scope."""
+
+    def __init__(self, rule: "LockDisciplineRule", rel: str,
+                 cls_name: str, method: str, guarded: Dict[str, Tuple],
+                 exempt: bool, findings: List[Finding]):
+        self.rule = rule
+        self.rel = rel
+        self.cls_name = cls_name
+        self.method = method
+        self.guarded = guarded
+        self.exempt = exempt            # __init__ / *_locked methods
+        self.findings = findings
+        self.held: Set[str] = set()
+        self.locks = {lock for lock, _ in guarded.values()}
+
+    # -- scope handling ---------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        got = _with_locks(node) & self.locks
+        added = got - self.held
+        self.held |= added
+        for item in node.items:
+            if item.context_expr is not None:
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held -= added
+
+    def visit_FunctionDef(self, node) -> None:
+        # A nested def may run after the lock is released (callbacks,
+        # threads): analyze it with no lock held and no exemption.
+        inner = _MethodChecker(self.rule, self.rel, self.cls_name,
+                               f"{self.method}.<{node.name}>",
+                               self.guarded, False, self.findings)
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        inner = _MethodChecker(self.rule, self.rel, self.cls_name,
+                               f"{self.method}.<lambda>",
+                               self.guarded, False, self.findings)
+        inner.visit(node.body)
+
+    # -- access checks ----------------------------------------------------
+
+    def _flag(self, node: ast.AST, msg: str, hint: str) -> None:
+        self.findings.append(Finding(
+            self.rule.id, self.rel, node.lineno,
+            f"{self.cls_name}.{self.method}: {msg}", hint=hint))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if _is_self_attr(node) and node.attr in self.guarded:
+            lock, reads_guarded = self.guarded[node.attr]
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            needs = write or reads_guarded
+            if needs and lock not in self.held and not self.exempt:
+                kind = "write to" if write else "read of"
+                self._flag(
+                    node,
+                    f"{kind} guarded attribute 'self.{node.attr}' "
+                    f"outside 'with self.{lock}'",
+                    f"take self.{lock}, move into a *_locked method, or "
+                    f"waive with justification")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (_is_self_attr(fn) and fn.attr.endswith("_locked")
+                and not self.exempt
+                and not (self.held & self.locks)):
+            self._flag(
+                node,
+                f"call to 'self.{fn.attr}()' outside lock scope — "
+                f"*_locked methods assume the caller holds the lock",
+                "call it under 'with self.<lock>' or from another "
+                "*_locked method")
+        self.generic_visit(node)
+
+
+class LockDisciplineRule(Rule):
+    id = "lock"
+    doc = ("guarded attributes (declared via _GUARDED_BY) are only "
+           "touched under their lock / in *_locked methods; *_locked "
+           "methods are only called from lock scope")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        for s in ctx.sources:
+            if s.tree is None:
+                continue
+            classes = {n.name: n for n in ast.walk(s.tree)
+                       if isinstance(n, ast.ClassDef)}
+            for cls in classes.values():
+                self._check_class(s, cls, classes, findings)
+        return findings
+
+    def _resolve_guarded(self, cls: ast.ClassDef,
+                         classes: Dict[str, ast.ClassDef],
+                         rel: str, findings: List[Finding],
+                         _depth: int = 0) -> Dict[str, Tuple[str, bool]]:
+        """Merge ``_GUARDED_BY`` down the (same-module) base chain —
+        ``Gauge(Counter)`` inherits the Counter declaration."""
+        out: Dict[str, Tuple[str, bool]] = {}
+        if _depth > 8:
+            return out
+        for base in cls.bases:
+            if isinstance(base, ast.Name) and base.id in classes:
+                out.update(self._resolve_guarded(
+                    classes[base.id], classes, rel, findings, _depth + 1))
+        try:
+            decl, line = class_literal(cls, GUARDED_ATTR)
+        except ValueError as e:
+            findings.append(Finding(
+                self.id, rel, cls.lineno, f"{cls.name}: {e}",
+                hint="declare _GUARDED_BY as a plain dict literal"))
+            return out
+        if decl is not None:
+            if not isinstance(decl, dict) or not all(
+                    isinstance(k, str) and isinstance(v, str)
+                    for k, v in decl.items()):
+                findings.append(Finding(
+                    self.id, rel, line,
+                    f"{cls.name}: {GUARDED_ATTR} must map attribute "
+                    f"names to lock specs ('LOCK' or 'LOCK/w')"))
+                return out
+            for attr, spec in decl.items():
+                out[attr] = _parse_spec(spec)
+        return out
+
+    def _check_class(self, s, cls: ast.ClassDef,
+                     classes: Dict[str, ast.ClassDef],
+                     findings: List[Finding]) -> None:
+        try:
+            external, ext_line = class_literal(cls, EXTERNAL_ATTR)
+        except ValueError as e:
+            findings.append(Finding(
+                self.id, s.rel, cls.lineno, f"{cls.name}: {e}"))
+            external, ext_line = None, 0
+        if external is not None:
+            self._check_external(s, cls, external, ext_line, findings)
+        guarded = self._resolve_guarded(cls, classes, s.rel, findings)
+        if not guarded:
+            return
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        # Every referenced lock must exist: created in __init__ — this
+        # class's or a (same-module) base's, since subclasses inherit
+        # the base lock (Gauge/Histogram under _Metric).
+        made_locks: Set[str] = set()
+        found_init = False
+        chain, seen_cls = [cls], {cls.name}
+        while chain:
+            c = chain.pop()
+            c_init = next(
+                (m for m in c.body
+                 if isinstance(m, ast.FunctionDef)
+                 and m.name == "__init__"), None)
+            if c_init is not None:
+                found_init = True
+                for node in ast.walk(c_init):
+                    if (isinstance(node, ast.Assign)
+                            and any(_is_self_attr(t)
+                                    for t in node.targets)):
+                        made_locks |= {t.attr for t in node.targets
+                                       if _is_self_attr(t)}
+            for base in c.bases:
+                if isinstance(base, ast.Name) and base.id in classes \
+                        and base.id not in seen_cls:
+                    seen_cls.add(base.id)
+                    chain.append(classes[base.id])
+        for lock in sorted({lk for lk, _ in guarded.values()}):
+            if found_init and lock not in made_locks:
+                findings.append(Finding(
+                    self.id, s.rel, cls.lineno,
+                    f"{cls.name}: _GUARDED_BY references "
+                    f"'self.{lock}' but __init__ never creates it",
+                    hint="create the lock in __init__ or fix the "
+                         "declaration"))
+        for m in methods:
+            if m.name == "__init__":
+                continue
+            is_locked = m.name.endswith("_locked")
+            checker = _MethodChecker(self, s.rel, cls.name, m.name,
+                                     guarded, is_locked, findings)
+            for stmt in m.body:
+                checker.visit(stmt)
+            if is_locked:
+                # A *_locked method that re-takes its own lock deadlocks
+                # (threading.Lock is non-reentrant).
+                locks = {lk for lk, _ in guarded.values()}
+                for node in ast.walk(m):
+                    if isinstance(node, ast.With) \
+                            and _with_locks(node) & locks:
+                        findings.append(Finding(
+                            self.id, s.rel, node.lineno,
+                            f"{cls.name}.{m.name}: *_locked method "
+                            f"takes the lock it asserts is already "
+                            f"held — deadlock on a non-reentrant "
+                            f"Lock"))
+
+    def _check_external(self, s, cls: ast.ClassDef, external,
+                        line: int, findings: List[Finding]) -> None:
+        """``_EXTERNAL_LOCK``: the class claims to be serialized by its
+        owner — so it must not manufacture concurrency of its own."""
+        if not isinstance(external, str):
+            findings.append(Finding(
+                self.id, s.rel, line,
+                f"{cls.name}: {EXTERNAL_ATTR} must be the owning "
+                f"'Class.lock' string"))
+            return
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "Thread" \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "threading":
+                findings.append(Finding(
+                    self.id, s.rel, node.lineno,
+                    f"{cls.name}: declared externally serialized by "
+                    f"{external} but spawns its own thread — the "
+                    f"external-lock claim is false"))
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Attribute) \
+                    and node.value.func.attr in ("Lock", "RLock") \
+                    and any(_is_self_attr(t) for t in node.targets):
+                findings.append(Finding(
+                    self.id, s.rel, node.lineno,
+                    f"{cls.name}: declared externally serialized by "
+                    f"{external} but creates its own lock — declare "
+                    f"_GUARDED_BY instead"))

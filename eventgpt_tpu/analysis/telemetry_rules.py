@@ -1,0 +1,350 @@
+"""Telemetry rules 1-5, migrated from ``scripts/lint_telemetry.py``
+(ISSUE 8 satellite). Semantics and messages are UNCHANGED — the shim in
+``scripts/lint_telemetry.py`` re-renders these findings in the legacy
+``file:line: message`` form so ``tests/test_lint_telemetry.py`` keeps
+asserting the same strings — but the rules now ride the shared
+``analysis.core`` walk and report through ``scripts/egpt_check.py``
+alongside the lock/hot-sync/jit analyzers.
+
+Rule ids (waiver grammar ``egpt-check: ignore[<id>] -- <reason>``):
+
+  * ``tele-clock``  — hot paths use ``time.perf_counter``, never
+    ``time.time`` (rule 1).
+  * ``tele-metric`` — metric-name grammar + registered exactly once
+    (rule 2; fails closed when the scan finds nothing).
+  * ``tele-doc``    — every registered ``egpt_*`` metric has an
+    OBSERVABILITY.md catalogue row (rule 3).
+  * ``tele-fault``  — every wired fault site is exercised by a
+    chaos/faults test (rule 4).
+  * ``tele-label``  — labelled observations stay inside the
+    ``METRIC_LABELS`` enums; wired fault sites must be members of the
+    fault-trip site enum (rule 5).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Tuple
+
+from eventgpt_tpu.analysis.core import Context, Finding, Rule, Source
+
+HOT_PATHS = (
+    "eventgpt_tpu/serve.py",
+    "eventgpt_tpu/faults.py",
+    "eventgpt_tpu/obs/",
+    "eventgpt_tpu/train/steps.py",
+    "eventgpt_tpu/train/prefetch.py",
+    "eventgpt_tpu/ops/",
+)
+
+METRIC_NAME_RE = re.compile(r"^egpt_[a-z0-9_]+$")
+_REG_RE = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*['\"]([A-Za-z0-9_.:-]+)['\"]")
+_FAULT_SITE_RE = re.compile(
+    r"maybe_(?:fail|delay)\(\s*['\"]([A-Za-z0-9_.]+)['\"]")
+_FAULT_TEST_RE = re.compile(r"faults\.configure\(|EGPT_FAULTS")
+_OBS_METHODS = ("inc", "observe", "set")
+_NON_LABEL_KWARGS = ("n",)
+_BANNED_LABEL_KEYS = ("rid", "request_id", "req_id", "id", "uid",
+                      "user", "user_id", "session_id")
+
+
+def _is_hot(rel: str) -> bool:
+    return any(rel == h or (h.endswith("/") and rel.startswith(h))
+               for h in HOT_PATHS)
+
+
+def _lineno(src: str, pos: int) -> int:
+    return src.count("\n", 0, pos) + 1
+
+
+def registrations(ctx: Context) -> Dict[str, Tuple[str, int]]:
+    """Metric name -> first (rel, line) registration site, raw-regex
+    over the scanned text (registrations wrap the name to the next line
+    in the catalogue's house style, which ``\\s`` crosses)."""
+    seen: Dict[str, Tuple[str, int]] = {}
+    for s in ctx.sources:
+        for m in _REG_RE.finditer(s.text):
+            name = m.group(1)
+            if name not in seen:
+                seen[name] = (s.rel, _lineno(s.text, m.start()))
+    return seen
+
+
+def fault_sites(ctx: Context) -> Dict[str, Tuple[str, int]]:
+    """Wired fault-site name -> first wiring site, runtime tree only."""
+    sites: Dict[str, Tuple[str, int]] = {}
+    for s in ctx.sources:
+        if not s.rel.startswith("eventgpt_tpu/"):
+            continue
+        for m in _FAULT_SITE_RE.finditer(s.text):
+            sites.setdefault(m.group(1), (s.rel, _lineno(s.text, m.start())))
+    return sites
+
+
+class HotClockRule(Rule):
+    id = "tele-clock"
+    doc = ("hot paths time with time.perf_counter, never time.time "
+           "(wall-clock jumps corrupt latency accounting)")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        out: List[Finding] = []
+        for s in ctx.sources:
+            if s.tree is None or not _is_hot(s.rel):
+                continue
+            for node in ast.walk(s.tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "time"
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "time"):
+                    out.append(Finding(
+                        self.id, s.rel, node.lineno,
+                        "time.time() in a hot path "
+                        "(use time.perf_counter)"))
+                if (isinstance(node, ast.ImportFrom)
+                        and node.module == "time"
+                        and any(a.name == "time" for a in node.names)):
+                    out.append(Finding(
+                        self.id, s.rel, node.lineno,
+                        "'from time import time' in a hot path "
+                        "(use time.perf_counter)"))
+        return out
+
+
+class MetricRegistrationRule(Rule):
+    id = "tele-metric"
+    doc = ("metric names match egpt_[a-z0-9_]+ and register exactly "
+           "once, in obs/metrics.py; fails closed on an empty scan")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        out: List[Finding] = []
+        seen: Dict[str, str] = {}
+        found = False
+        for s in ctx.sources:
+            for m in _REG_RE.finditer(s.text):
+                found = True
+                name = m.group(1)
+                line = _lineno(s.text, m.start())
+                site = f"{s.rel}:{line}"
+                if not METRIC_NAME_RE.match(name):
+                    out.append(Finding(
+                        self.id, s.rel, line,
+                        f"metric name {name!r} does not match "
+                        f"{METRIC_NAME_RE.pattern}"))
+                if name in seen:
+                    out.append(Finding(
+                        self.id, s.rel, line,
+                        f"metric {name!r} registered twice "
+                        f"(first at {seen[name]}) — define metrics once, "
+                        f"in obs/metrics.py"))
+                else:
+                    seen[name] = site
+        if not found:
+            out.append(Finding(
+                self.id, "", 0,
+                "no metric registrations found — the scan "
+                "pattern or tree layout changed under the lint"))
+        return out
+
+
+class CatalogueRule(Rule):
+    id = "tele-doc"
+    doc = "every registered egpt_* metric has an OBSERVABILITY.md row"
+
+    def run(self, ctx: Context) -> List[Finding]:
+        try:
+            with open(os.path.join(ctx.root, "OBSERVABILITY.md")) as f:
+                doc = f.read()
+        except OSError:
+            doc = ""
+        out: List[Finding] = []
+        for name, (rel, line) in sorted(registrations(ctx).items()):
+            if METRIC_NAME_RE.match(name) and name not in doc:
+                out.append(Finding(
+                    self.id, rel, line,
+                    f"metric {name!r} has no catalogue row in "
+                    f"OBSERVABILITY.md — document every registered "
+                    f"metric"))
+        return out
+
+
+class FaultCoverageRule(Rule):
+    id = "tele-fault"
+    doc = ("every wired maybe_fail/maybe_delay site appears in a tests/ "
+           "file that arms injection")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        sites = fault_sites(ctx)
+        out: List[Finding] = []
+        if not sites:
+            if os.path.isdir(os.path.join(ctx.root, "eventgpt_tpu")):
+                out.append(Finding(
+                    self.id, "", 0,
+                    "no fault sites found under eventgpt_tpu/ — "
+                    "the scan pattern changed under the lint"))
+            return out
+        chaos_text = []
+        tests = os.path.join(ctx.root, "tests")
+        if os.path.isdir(tests):
+            for f in sorted(os.listdir(tests)):
+                if not f.endswith(".py"):
+                    continue
+                with open(os.path.join(tests, f)) as fh:
+                    src = fh.read()
+                if _FAULT_TEST_RE.search(src):
+                    chaos_text.append(src)
+        blob = "\n".join(chaos_text)
+        for name, (rel, line) in sorted(sites.items()):
+            if name not in blob:
+                out.append(Finding(
+                    self.id, rel, line,
+                    f"fault site {name!r} is not exercised by any "
+                    f"chaos/faults test (no tests/ file arming injection "
+                    f"mentions it) — unreachable failure handling rots"))
+        return out
+
+
+def _metric_var_map(sources) -> Dict[str, str]:
+    """Assignment targets bound to a metric registration — how label
+    checks resolve an observation's receiver back to its catalogue
+    entry."""
+    out: Dict[str, str] = {}
+    for s in sources:
+        if s.tree is None:
+            continue
+        for node in ast.walk(s.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr in ("counter", "gauge",
+                                                 "histogram")
+                    and node.value.args
+                    and isinstance(node.value.args[0], ast.Constant)
+                    and isinstance(node.value.args[0].value, str)):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.value.args[0].value
+    return out
+
+
+def _metric_label_enums(sources) -> Dict[str, Dict[str, tuple]]:
+    """``METRIC_LABELS`` from obs/metrics.py — a pure literal by
+    contract, read statically."""
+    for s in sources:
+        if not s.rel.endswith("obs/metrics.py") or s.tree is None:
+            continue
+        for node in ast.walk(s.tree):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "METRIC_LABELS"
+                            for t in node.targets)):
+                try:
+                    return ast.literal_eval(node.value)
+                except ValueError:
+                    return {}
+    return {}
+
+
+def _literal_label_values(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant):
+        return [node.value] if isinstance(node.value, str) else []
+    if isinstance(node, ast.IfExp):
+        return (_literal_label_values(node.body)
+                + _literal_label_values(node.orelse))
+    return []
+
+
+class LabelEnumRule(Rule):
+    id = "tele-label"
+    doc = ("labelled metric observations draw values from the fixed "
+           "METRIC_LABELS enums (bounded cardinality); wired fault "
+           "sites must be members of the fault-trip site enum")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        out: List[Finding] = []
+        var_map = _metric_var_map(ctx.sources)
+        enums = _metric_label_enums(ctx.sources)
+        for s in ctx.sources:
+            if s.tree is None:
+                continue
+            for node in ast.walk(s.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _OBS_METHODS):
+                    continue
+                recv = node.func.value
+                var = (recv.id if isinstance(recv, ast.Name)
+                       else recv.attr if isinstance(recv, ast.Attribute)
+                       else None)
+                metric = var_map.get(var or "")
+                if metric is None:
+                    continue  # not a metric object (Event.set, queue, ..)
+                declared = enums.get(metric, {})
+                for kw in node.keywords:
+                    if kw.arg is None or kw.arg in _NON_LABEL_KWARGS:
+                        continue
+                    if kw.arg in _BANNED_LABEL_KEYS:
+                        out.append(Finding(
+                            self.id, s.rel, node.lineno,
+                            f"metric {metric!r} labelled with "
+                            f"{kw.arg!r} — per-request identity labels "
+                            f"are unbounded cardinality, banned "
+                            f"outright"))
+                        continue
+                    allowed = declared.get(kw.arg)
+                    if allowed is None:
+                        out.append(Finding(
+                            self.id, s.rel, node.lineno,
+                            f"metric {metric!r} label {kw.arg!r} has "
+                            f"no declared enum in obs/metrics.py "
+                            f"METRIC_LABELS — labelled observations "
+                            f"must draw values from a fixed catalogue "
+                            f"enum"))
+                        continue
+                    if isinstance(kw.value, ast.JoinedStr) or (
+                            isinstance(kw.value, ast.Call)
+                            and isinstance(kw.value.func, ast.Name)
+                            and kw.value.func.id in ("str", "repr",
+                                                     "format")):
+                        out.append(Finding(
+                            self.id, s.rel, node.lineno,
+                            f"metric {metric!r} label {kw.arg!r} is "
+                            f"computed (f-string/str()) — unbounded "
+                            f"label values are banned; use an enum "
+                            f"member"))
+                        continue
+                    if (isinstance(kw.value, ast.Constant)
+                            and not isinstance(kw.value.value, str)):
+                        out.append(Finding(
+                            self.id, s.rel, node.lineno,
+                            f"metric {metric!r} label {kw.arg!r} is "
+                            f"the non-string literal "
+                            f"{kw.value.value!r} — request-id-shaped "
+                            f"labels are banned"))
+                        continue
+                    for lit in _literal_label_values(kw.value):
+                        if lit not in allowed:
+                            out.append(Finding(
+                                self.id, s.rel, node.lineno,
+                                f"metric {metric!r} label "
+                                f"{kw.arg!r}={lit!r} outside the "
+                                f"declared enum {tuple(allowed)}"))
+        trip_sites = enums.get("egpt_fault_trips_total", {}).get("site")
+        if trip_sites is not None:
+            for name, (rel, line) in sorted(fault_sites(ctx).items()):
+                if name not in trip_sites:
+                    out.append(Finding(
+                        self.id, rel, line,
+                        f"fault site {name!r} missing from "
+                        f"egpt_fault_trips_total's site enum "
+                        f"(obs/metrics.py METRIC_LABELS) — its first "
+                        f"trip would raise at observe time"))
+        return out
+
+
+TELEMETRY_RULES = (HotClockRule(), MetricRegistrationRule(),
+                   CatalogueRule(), FaultCoverageRule(), LabelEnumRule())

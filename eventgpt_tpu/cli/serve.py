@@ -85,7 +85,40 @@ class ServingEngine:
     arms the same atomic liveness file the trainer writes
     (``train/resilience.Heartbeat``) so one external watchdog convention
     covers both.
+
+    Lock discipline (egpt_check rule ``lock``): ``_GUARDED_BY`` below is
+    the checkable contract. Full-guard attributes are only touched under
+    ``_lock`` (or in ``*_locked`` helpers); ``/w`` attributes take the
+    lock to WRITE but are read lock-free by design — the snapshot/flag
+    pattern that lets ``/health``, ``/stats`` and ``breaker_open()``
+    answer inside a probe timeout while the scheduler thread holds the
+    lock through a multi-second decode segment (reads of a
+    GIL-atomically swapped dict/bool/int are safe; readers tolerate
+    one-step staleness). ``_wake``/``_stop``/``_thread`` and the
+    scheduler-thread-private fields (``_n_steps``, ``_last_beat``) are
+    deliberately undeclared: Event is self-synchronizing and the rest
+    are single-thread state.
     """
+
+    _GUARDED_BY = {
+        # full guard: multi-step mutations that must be atomic
+        "batcher": "_lock",
+        "_answers": "_lock",
+        "_sent": "_lock",
+        "_abandoned": "_lock",
+        # writes locked, lock-free reads by design (see docstring)
+        "_done": "_lock/w",
+        "_status": "_lock/w",
+        "_streams": "_lock/w",
+        "_dead": "_lock/w",
+        "_snapshot": "_lock/w",
+        "_consec_faults": "_lock/w",
+        "_t_fault": "_lock/w",
+        "fault": "_lock/w",
+        "n_faults": "_lock/w",
+        "n_restarts": "_lock/w",
+        "n_requests": "_lock/w",
+    }
 
     def __init__(self, batcher, tokenizer, conv_mode: str = "eventgpt_v1",
                  breaker_threshold: int = 3,
@@ -133,7 +166,7 @@ class ServingEngine:
         # a load balancer's probe timeout even while the scheduler thread
         # holds the lock through a multi-second decode segment. Rebuilt
         # after every step; staleness is bounded by one segment.
-        self._snapshot: Dict[str, Any] = self._build_snapshot()
+        self._snapshot: Dict[str, Any] = self._build_snapshot_locked()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -193,8 +226,8 @@ class ServingEngine:
         with self._lock:
             ok = self.batcher.cancel(rid)
             if ok:
-                self._harvest()
-                self._snapshot = self._build_snapshot()
+                self._harvest_locked()
+                self._snapshot = self._build_snapshot_locked()
         if ok:
             self._wake.set()
         return ok
@@ -280,8 +313,8 @@ class ServingEngine:
             self._dead = True
             # Finished-but-uncollected answers are real results — hand
             # them to try_result instead of re-running them elsewhere.
-            self._push_stream_deltas()
-            self._harvest()
+            self._push_stream_deltas_locked()
+            self._harvest_locked()
             recs = self.batcher.export_requests()
             for rec in recs:
                 rid = rec["rid"]
@@ -289,7 +322,7 @@ class ServingEngine:
                 self._streams.pop(rid, None)
                 self._sent.pop(rid, None)
                 self._abandoned.discard(rid)
-            self._snapshot = self._build_snapshot()
+            self._snapshot = self._build_snapshot_locked()
         self._wake.set()
         return recs
 
@@ -301,7 +334,7 @@ class ServingEngine:
             self._dead = False
             self._consec_faults = 0
             self.fault = None
-            self._snapshot = self._build_snapshot()
+            self._snapshot = self._build_snapshot_locked()
         self._wake.set()
 
     @property
@@ -329,7 +362,7 @@ class ServingEngine:
         (``{"fault": repr}``) — consumers must surface it, not decode it."""
         return self._streams[rid]
 
-    def _build_snapshot(self) -> Dict[str, Any]:
+    def _build_snapshot_locked(self) -> Dict[str, Any]:
         """Caller holds the lock (or the batcher is idle at init)."""
         b = self.batcher
         return {
@@ -405,8 +438,8 @@ class ServingEngine:
                                         for r in self.batcher.rows)))
                     if busy:
                         self.batcher.step()
-                        self._push_stream_deltas()
-                        self._harvest()
+                        self._push_stream_deltas_locked()
+                        self._harvest_locked()
                         self._n_steps += 1
                         if self._consec_faults:
                             # A clean step closes the breaker: the fault
@@ -417,7 +450,7 @@ class ServingEngine:
                         # Snapshot only when state moved (idle polls would
                         # rebuild 10x/s for nothing); submits wake the
                         # loop, so queue growth shows within one pass.
-                        self._snapshot = self._build_snapshot()
+                        self._snapshot = self._build_snapshot_locked()
             except Exception as e:  # scheduler death must be LOUD
                 self._on_fault(e)
                 if not self._stop:
@@ -425,7 +458,8 @@ class ServingEngine:
                     # may have left this one's stack in a weird spot);
                     # brief backoff so a hard fault loop cannot spin.
                     time.sleep(min(0.05 * self._consec_faults, 0.5))
-                    self.n_restarts += 1
+                    with self._lock:
+                        self.n_restarts += 1
                     obs_metrics.SERVE_SCHED_RESTARTS.inc()
                     self._thread = threading.Thread(
                         target=self._loop, daemon=True)
@@ -465,11 +499,17 @@ class ServingEngine:
         circuit breaker when the streak reaches the threshold (then
         queued requests are failed too and submits are refused until the
         cooldown's half-open probe)."""
-        self.fault = repr(e)
-        self.n_faults += 1
-        self._consec_faults += 1
-        self._t_fault = time.monotonic()
-        tripped = self._consec_faults >= self.breaker_threshold
+        with self._lock:
+            # Fault bookkeeping mutates under the lock (the race detector
+            # caught the old lock-free increments): revive() zeroes
+            # _consec_faults under the lock from another thread, so an
+            # unlocked += here could lose the trip that opens the
+            # breaker.
+            self.fault = repr(e)
+            self.n_faults += 1
+            self._consec_faults += 1
+            self._t_fault = time.monotonic()
+            tripped = self._consec_faults >= self.breaker_threshold
         obs_metrics.SERVE_SCHED_FAULTS.inc()
         obs_trace.instant("scheduler_fault", cat="engine", error=repr(e))
         if tripped:
@@ -523,9 +563,9 @@ class ServingEngine:
                     # entry stays for a waiter that arrives post-sweep).
                     self._done[rid].set()
                 self._abandoned.discard(rid)
-            self._snapshot = self._build_snapshot()
+            self._snapshot = self._build_snapshot_locked()
 
-    def _push_stream_deltas(self) -> None:
+    def _push_stream_deltas_locked(self) -> None:
         for req in self.batcher.rows:
             if req is None or req.rid not in self._streams:
                 continue
@@ -534,7 +574,7 @@ class ServingEngine:
                 self._streams[req.rid].put(list(req.tokens[:n]))
                 self._sent[req.rid] = n
 
-    def _harvest(self) -> None:
+    def _harvest_locked(self) -> None:
         if not self.batcher.finished:
             return
         done, self.batcher.finished = self.batcher.finished, {}

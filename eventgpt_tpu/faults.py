@@ -114,7 +114,11 @@ class _Site:
 
 class FaultRegistry:
     """Parsed fault plan: site name -> firing rule. Thread-safe (the
-    serving engine probes sites from scheduler + handler threads)."""
+    serving engine probes sites from scheduler + handler threads): the
+    site map and the per-site counters it shields only move under
+    ``_lock`` (``_GUARDED_BY`` — egpt_check rule ``lock``)."""
+
+    _GUARDED_BY = {"_sites": "_lock"}
 
     def __init__(self, spec: str, seed: int = 0):
         self.spec = spec
@@ -153,10 +157,14 @@ class FaultRegistry:
         normal wiring) advances each rule's counters exactly once per
         pass.
         """
-        s = self._sites.get(site)
-        if s is None or bool(s.delay_s) is not want_delay:
-            return None
         with self._lock:
+            # The site lookup moved under the lock with the counters it
+            # shields (the race detector's finding): _sites itself is
+            # init-built, but reading it lock-free while another thread
+            # advances its _Site counters made the guard partial.
+            s = self._sites.get(site)
+            if s is None or bool(s.delay_s) is not want_delay:
+                return None
             return s if s.should_fire() else None
 
     def stats(self) -> Dict[str, Dict[str, int]]:

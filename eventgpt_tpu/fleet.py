@@ -210,7 +210,36 @@ class Fleet:
     """Replica supervisor + router with the client surface of a
     ``ServingEngine`` (submit / result / status / cancel / stream_queue
     / stats / breaker_open / set_prefix), so ``cli.serve.make_handler``
-    serves a fleet unchanged. See the module docstring for policy."""
+    serves a fleet unchanged. See the module docstring for policy.
+
+    Lock discipline (egpt_check rule ``lock``): ``_GUARDED_BY`` is the
+    checkable contract. The routing table (``_pins``), the request map's
+    WRITES, and every host counter mutate under ``_lock``; ``/w``
+    attributes are read lock-free by design (``result`` must not hold
+    the lock while waiting; ``status``/``stream_queue`` tolerate
+    one-tick staleness on a GIL-atomic dict read). Lock ORDER is fleet
+    -> engine: ``submit_ids`` holds ``_lock`` across
+    ``engine.submit_ids`` (which takes the engine lock); engine code
+    never takes the fleet lock, so the order cannot invert. Replica
+    ``state`` strings are a documented exception: single-writer from
+    the supervisor thread in steady state, with the rare operator
+    ``kill_replica``/``restart_replica`` transitions idempotent —
+    cross-object fields are outside the detector's static scope either
+    way (see analysis/lock_discipline.py "Known static limits")."""
+
+    _GUARDED_BY = {
+        # full guard: routing/bookkeeping state with compound updates
+        "_pins": "_lock",
+        "_next_frid": "_lock",
+        "n_shed": "_lock",
+        # writes locked; lock-free reads are the snapshot/flag pattern
+        "_requests": "_lock/w",
+        "n_requests": "_lock/w",
+        "n_failovers": "_lock/w",
+        "n_kills": "_lock/w",
+        "n_route_faults": "_lock/w",
+        "fault": "_lock/w",
+    }
 
     def __init__(self, engines: Sequence[Any], tokenizer=None,
                  conv_mode: str = "eventgpt_v1",
@@ -315,7 +344,7 @@ class Fleet:
         self._maybe_shed(slo)
         key = affinity_key(input_ids, pixels)
         with self._lock:
-            rep, reason = self._route(key)
+            rep, reason = self._route_locked(key)
             rid = rep.engine.submit_ids(
                 list(input_ids), pixels, max_new_tokens, stream=stream,
                 deadline_s=deadline_s, slo=slo)
@@ -395,6 +424,11 @@ class Fleet:
                     rep.engine.batcher.prefix_cache_stats().get(
                         "hit_ratio", 0.0),
             })
+        with self._lock:
+            # _pins/n_shed are compound-mutated (full guard): snapshot
+            # under the lock — dict(d) can raise if d resizes mid-copy.
+            n_pins = len(self._pins)
+            shed = dict(self.n_shed)
         return {
             "uptime_s": round(time.time() - self.t_start, 1),
             "requests": self.n_requests,
@@ -404,9 +438,9 @@ class Fleet:
             "fleet": {
                 "replicas": len(self.replicas),
                 "routable": sum(r.routable for r in self.replicas),
-                "pins": len(self._pins),
+                "pins": n_pins,
                 "goodput_ratio": round(self.goodput_ratio(), 4),
-                "shed": dict(self.n_shed),
+                "shed": shed,
                 "failovers": self.n_failovers,
                 "kills": self.n_kills,
                 "route_faults": self.n_route_faults,
@@ -449,10 +483,11 @@ class Fleet:
     def reset_stats(self) -> None:
         """Zero the phase-scoped host counters (the bench's per-point
         reset; replica-level resets are the caller's, as ever)."""
-        self.n_shed = {}
-        self.n_failovers = 0
-        self.n_kills = 0
-        self.n_route_faults = 0
+        with self._lock:
+            self.n_shed = {}
+            self.n_failovers = 0
+            self.n_kills = 0
+            self.n_route_faults = 0
 
     def shutdown(self) -> None:
         self._stop = True
@@ -462,7 +497,7 @@ class Fleet:
 
     # -- routing ----------------------------------------------------------
 
-    def _route(self, key: tuple):
+    def _route_locked(self, key: tuple):
         """(replica, reason) for one submit. Affinity first: the key's
         pinned replica, while routable. A ``fleet.route`` chaos trip
         degrades THIS decision to least-queue (the handling contract:
@@ -530,8 +565,14 @@ class Fleet:
         rep.state = "dead"
         rep.t_dead = time.monotonic()
         rep.kills += 1
-        self.n_kills += 1
-        self.fault = f"replica {idx} killed"
+        with self._lock:
+            # Counter/fault writes go under the lock (the lock contract;
+            # rep.state above is the documented Replica exception).
+            # engine.kill() below stays OUTSIDE it: fleet -> engine is
+            # the lock order, and kill holds the engine lock for a full
+            # drain.
+            self.n_kills += 1
+            self.fault = f"replica {idx} killed"
         obs_metrics.FLEET_REPLICA_DEATHS.inc()
         obs_trace.instant("replica_kill", cat="fleet")
         self._export_routable_gauge()
@@ -546,7 +587,7 @@ class Fleet:
                 if freq.stream:
                     # Mid-stream failover would replay already-sent
                     # bytes; surface the fault like an engine death.
-                    self._finish(freq, None, "engine_fault")
+                    self._finish_locked(freq, None, "engine_fault")
                     if freq.stream_q is not None:
                         freq.stream_q.put({"fault": self.fault})
                     continue
@@ -577,14 +618,14 @@ class Fleet:
         prefix locality there instead of bouncing per turn."""
         freq.failovers += 1
         if freq.failovers > self.max_failovers:
-            self._finish(freq, None, "engine_fault")
+            self._finish_locked(freq, None, "engine_fault")
             return
         pool = [r for r in self.replicas
                 if r.routable and r.idx != freq.replica]
         if not pool:
             pool = [r for r in self.replicas if r.routable]
         if not pool:
-            self._finish(freq, None, "engine_fault")
+            self._finish_locked(freq, None, "engine_fault")
             return
         rep = min(pool, key=lambda r: (r.depth(), r.idx))
         try:
@@ -593,7 +634,7 @@ class Fleet:
                 deadline_s=deadline_s, slo=freq.slo)
         except Exception as e:  # survivor refused (full/degraded): give up
             self.fault = repr(e)
-            self._finish(freq, None, "engine_fault")
+            self._finish_locked(freq, None, "engine_fault")
             return
         freq.replica = rep.idx
         self._pins[freq.key] = rep.idx
@@ -601,7 +642,8 @@ class Fleet:
         obs_metrics.FLEET_FAILOVERS.inc()
         obs_metrics.FLEET_ROUTED.inc(reason="repin")
 
-    def _finish(self, freq: _FleetRequest, tokens, status: str) -> None:
+    def _finish_locked(self, freq: _FleetRequest, tokens,
+                       status: str) -> None:
         freq.tokens = tokens
         freq.status = status
         freq.done.set()
@@ -636,7 +678,8 @@ class Fleet:
                 self._export_routable_gauge()
                 obs_metrics.FLEET_QUEUE_DEPTH.set(self.queue_depth())
             except Exception as e:  # defensive: supervision must survive
-                self.fault = repr(e)
+                with self._lock:
+                    self.fault = repr(e)
             time.sleep(self.probe_interval_s)
 
     def _probe(self, rep: Replica) -> None:
@@ -678,7 +721,7 @@ class Fleet:
                 st = rep.engine.try_status(freq.rid)
                 if st is not None:
                     with self._lock:
-                        self._finish(freq, [], st)
+                        self._finish_locked(freq, [], st)
                 continue
             got = rep.engine.try_result(freq.rid)
             if got is None:
@@ -693,7 +736,7 @@ class Fleet:
             with self._lock:
                 freq.stats = dict(
                     rep.engine.batcher.request_stats.get(freq.rid, {}))
-                self._finish(freq, tokens, status)
+                self._finish_locked(freq, tokens, status)
 
     def _export_routable_gauge(self) -> None:
         obs_metrics.FLEET_ROUTABLE.set(

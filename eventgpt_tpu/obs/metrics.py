@@ -184,6 +184,12 @@ class _Metric:
 class Counter(_Metric):
     kind = "counter"
 
+    # Lock contract (egpt_check rule ``lock``): the sample map only
+    # mutates/reads under the metric's own lock — scheduler, handler
+    # and trainer threads all observe concurrently. Gauge inherits
+    # this declaration (same-module base resolution).
+    _GUARDED_BY = {"_values": "_lock"}
+
     def __init__(self, name, help, registry):
         super().__init__(name, help, registry)
         self._values: Dict[tuple, float] = {}
@@ -241,6 +247,9 @@ class Histogram(_Metric):
     segment's worth of per-token gaps)."""
 
     kind = "histogram"
+
+    _GUARDED_BY = {"_counts": "_lock", "_sums": "_lock",
+                   "_totals": "_lock"}
 
     def __init__(self, name, help, registry,
                  buckets: Sequence[float] = LATENCY_BUCKETS):
@@ -336,7 +345,15 @@ class Histogram(_Metric):
 
 class Registry:
     """Name -> metric, rendered in registration order. One process-global
-    instance (``REGISTRY``) below; tests build private ones."""
+    instance (``REGISTRY``) below; tests build private ones.
+
+    Lock contract: the metric map and the common-label tuple mutate
+    under ``_lock``; ``_common`` reads are lock-free (``/w`` — an
+    atomically swapped tuple, set once at worker start). ``enabled`` is
+    deliberately undeclared: a bare bool flag read once per observation
+    (the A/B disarm switch), GIL-atomic by construction."""
+
+    _GUARDED_BY = {"_metrics": "_lock", "_common": "_lock/w"}
 
     def __init__(self):
         self._metrics: Dict[str, _Metric] = {}
@@ -375,7 +392,9 @@ class Registry:
         """Labels stamped on every exposed sample — e.g. the per-process
         ``process="3"`` label multiproc workers set so one scrape target
         per host stays disambiguated (DISTRIBUTED.md)."""
-        self._common = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            self._common = tuple(
+                sorted((k, str(v)) for k, v in labels.items()))
 
     def reset(self) -> None:
         """Zero every value (registration survives) — phase-scoped

@@ -28,6 +28,7 @@ workers plus a single-process reference job and compares losses.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import socket
@@ -78,6 +79,17 @@ def _put_global(tree, specs, mesh):
     return jax.tree_util.tree_map(put, tree, shardings)
 
 
+@functools.lru_cache(maxsize=8)
+def _gather_jit(rep):
+    """One replicate-to-host executable per target sharding — rebuilding
+    ``jax.jit(lambda ...)`` inside ``gather`` re-traced per LEAF (the
+    jit-hygiene rule's untracked-creation case); shardings are hashable,
+    so the lru key is the executable's identity."""
+    import jax
+
+    return jax.jit(lambda v: v, out_shardings=rep)
+
+
 def _replicate_to_host(tree):
     """Gather a (possibly cross-process) sharded pytree to host numpy on
     every process: jit to a fully-replicated layout, then device_get."""
@@ -87,7 +99,7 @@ def _replicate_to_host(tree):
     def gather(x):
         mesh = x.sharding.mesh
         rep = NamedSharding(mesh, P())
-        return jax.device_get(jax.jit(lambda v: v, out_shardings=rep)(x))
+        return jax.device_get(_gather_jit(rep)(x))
 
     return jax.tree_util.tree_map(gather, tree)
 

@@ -130,6 +130,7 @@ def _pixels_key(pixel_values) -> bytes:
     medium: token ids alone cannot distinguish two streams)."""
     import hashlib
 
+    # egpt-check: ignore[hot-sync] -- request pixels are host numpy by the submit() contract; this hashes host bytes, no device value exists here
     arr = np.ascontiguousarray(np.asarray(pixel_values, np.float32))
     return str(arr.shape).encode() + hashlib.sha1(arr.tobytes()).digest()
 
@@ -181,7 +182,26 @@ class PrefixCache:
     Mutations are host-side dict ops under ``_lock`` (the scheduler
     thread inserts/looks up; HTTP handler threads read ``stats()``).
     Device arrays are only ever referenced, never mutated in place.
+    ``budget`` is immutable after construction (undeclared below on
+    purpose); ``_PrefixEntry.pins`` mutates under the OWNING engine's
+    lock (every pin/drain site is scheduler-thread code), which the
+    eviction sweep also runs under — the entry objects ride the
+    batcher's external serialization, not this lock.
     """
+
+    # Lock-discipline contract (egpt_check rule ``lock``): every
+    # read/write of these goes through ``with self._lock`` or a
+    # ``*_locked`` helper.
+    _GUARDED_BY = {
+        "_root": "_lock",
+        "bytes": "_lock",
+        "n_entries": "_lock",
+        "hits": "_lock",
+        "misses": "_lock",
+        "evictions": "_lock",
+        "insertions": "_lock",
+        "_tick": "_lock",
+    }
 
     def __init__(self, budget_bytes: int = 0):
         import threading
@@ -197,7 +217,7 @@ class PrefixCache:
         self.insertions = 0
         self._tick = 0
 
-    def _iter_nodes(self):
+    def _iter_nodes_locked(self):
         stack = [self._root]
         while stack:
             node = stack.pop()
@@ -206,7 +226,7 @@ class PrefixCache:
 
     def entries(self) -> List[_PrefixEntry]:
         with self._lock:
-            return [e for node in self._iter_nodes()
+            return [e for node in self._iter_nodes_locked()
                     for e in node["e"].values()]
 
     def get(self, ids, pixels_key) -> Optional[_PrefixEntry]:
@@ -296,7 +316,10 @@ class PrefixCache:
             self.n_entries += 1
             self.insertions += 1
             self._evict_locked()
-        self._export_gauges()
+            # Gauge export reads bytes/n_entries: stay under the lock
+            # (metric locks are leaf locks — the order here is always
+            # PrefixCache._lock -> _Metric._lock, never reversed).
+            self._export_gauges_locked()
         obs_metrics.SERVE_PREFIX_INSERTIONS.inc()
         return True
 
@@ -305,7 +328,7 @@ class PrefixCache:
             return
         while self.bytes > self.budget:
             victim_node, victim_key, victim = None, None, None
-            for node in self._iter_nodes():
+            for node in self._iter_nodes_locked():
                 for key, e in node["e"].items():
                     if e.pins > 0:
                         continue  # refcount pin: in-flight rows admit from it
@@ -321,7 +344,7 @@ class PrefixCache:
             self.evictions += 1
             obs_metrics.SERVE_PREFIX_EVICTIONS.inc()
 
-    def _export_gauges(self) -> None:
+    def _export_gauges_locked(self) -> None:
         obs_metrics.SERVE_PREFIX_BYTES.set(self.bytes)
         obs_metrics.SERVE_PREFIX_ENTRIES.set(self.n_entries)
 
@@ -332,7 +355,7 @@ class PrefixCache:
                 {"ids_len": len(e.ids), "has_event": e.has_event,
                  "length": e.length, "bucket": e.bucket,
                  "nbytes": e.nbytes, "pins": e.pins, "hits": e.hits}
-                for node in self._iter_nodes() for e in node["e"].values()
+                for node in self._iter_nodes_locked() for e in node["e"].values()
             ]
             return {
                 "entries": sorted(entries, key=lambda d: -d["hits"]),
@@ -1187,7 +1210,26 @@ class ContinuousBatcher:
     pins every scheduler jit's out-shardings (BASELINE config 5: 13B
     continuous batching needs the serving mesh AND row-level admission at
     once — vs the reference's single-GPU one-shot ``inference.py:52-63``).
+
+    Threading contract (egpt_check rule ``lock``): this class is
+    single-threaded BY DESIGN — every method touches resident device
+    buffers, and the owning ``ServingEngine`` serializes all access
+    behind its ``_lock`` (``_EXTERNAL_LOCK`` below). It must never
+    spawn a thread or grow a lock of its own; state shared lock-free
+    with handler threads (``request_stats``, ``finished`` snapshots)
+    is read-only on their side and bounded here.
+
+    Dispatch-path contract (rule ``hot-sync``): the hot set rooted at
+    ``step``/``_dispatch_segment`` (``_HOT_ROOTS``) contains no host
+    sync — ``.item()``, ``jax.device_get``, ``np.asarray`` of device
+    values, ``block_until_ready`` — except at the three annotated
+    harvest points (``_harvest_segment``; the admission NaN-quarantine
+    readbacks in ``_scatter_wave``/``_finish_admission``). That is the
+    static guarantee behind the pipelined scheduler's overlap ratio.
     """
+
+    _EXTERNAL_LOCK = "ServingEngine._lock"
+    _HOT_ROOTS = ("step", "_dispatch_segment")
 
     def __init__(
         self,
@@ -2628,6 +2670,7 @@ class ContinuousBatcher:
                         args={"chunk": chunk})
         return rec
 
+    # egpt-check: harvest -- THE designed blocking point: fetches a settled segment; downstream runs on harvested host state
     def _harvest_segment(self, rec: dict) -> None:
         """Fetch one dispatched segment's outputs (the host blocks HERE,
         and only here) and apply the row bookkeeping: commit tokens,
@@ -3362,6 +3405,7 @@ class ContinuousBatcher:
         self._scatter_wave(wave, wave_cache, wave_logits, wave_hidden,
                            prompt_lens)
 
+    # egpt-check: harvest -- admission NaN quarantine is a mandated readback of the wave logits before they touch the shared cache
     def _scatter_wave(self, members: List[tuple], wave_cache, wave_logits,
                       wave_hidden, prompt_lens: List[int],
                       entries: Optional[List[_PrefixEntry]] = None) -> None:
@@ -3468,6 +3512,7 @@ class ContinuousBatcher:
             return fn(cache["k"], cache["v"], row_arr)
         return _slice_prefix_jit(cache["k"], cache["v"], row_arr, bucket)
 
+    # egpt-check: harvest -- admission NaN quarantine reads back the row logits before the row joins the shared cache
     def _finish_admission(self, req, row, prompt_len, row_cache,
                           row_logits, row_hidden=None,
                           prefix_entry=None) -> None:

@@ -220,7 +220,10 @@ def make_train_step(
 def make_eval_step(cfg: EventChatConfig,
                    combine: Callable[[Params, Params], Params] = stage1_combine,
                    mesh=None):
-    @jax.jit
+    # Explicit empty pins: eval reuses ``state`` across batches, so
+    # nothing may be donated, and there are no static args (jit-hygiene
+    # convention — pins are declared, never implied).
+    @functools.partial(jax.jit, static_argnames=(), donate_argnums=())
     def step(state: TrainState, batch: Batch):
         params = combine(state.trainable, state.frozen)
         embeds = multimodal_embeds(params, cfg, batch, mesh=mesh)

@@ -31,6 +31,13 @@ PERFORMANCE.md):
   * **Paired sweep points.** ``"sweep"`` lists (workload records) match
     pointwise by ``rate_mult``; ``"ab"`` interleaved arrays compare by
     their means.
+  * **tok_s pairs only on trace identity.** A workload record's tok/s
+    is (trace token budget) / duration, so it is only comparable across
+    records generated with the SAME output-cap flags (``output_min`` /
+    ``output_max``, recorded since ISSUE 8). Records whose identity
+    differs — or predates the keys — have their tok_s keys dropped with
+    a note; ``--require tok_s`` on such a pair fails loudly as
+    not-comparable.
 
 Only the performance-shaped keys are gated (``_GATE_PATTERNS``); config
 echo keys (batch, chunk, seeds, counts) are identity context, not
@@ -112,6 +119,25 @@ def _flatten(rec: Dict[str, Any], prefix: str = "") -> Dict[str, float]:
     return out
 
 
+def _trace_identity(rec: Dict[str, Any]) -> Optional[Tuple]:
+    """The keys that make two workload records' tok_s comparable: the
+    trace is a pure function of (seed, requests, arrival, sessions,
+    output caps), and with eos-free replay tok_s is (sum of budgets) /
+    duration — so SAME identity = pairable, different or unrecorded =
+    structurally skewed (ISSUE 8 satellite: WORKLOAD_r01's pre-fix
+    tok_s implied ~1665 served tokens where the current trace budgets
+    sum to 1151, because the output-cap flags at r01 time were never
+    recorded). Returns None for non-workload records (no sweep), ()
+    for a workload record that predates the cap keys."""
+    r = _unwrap(rec)
+    if "sweep" not in r:
+        return None
+    if "output_min" not in r or "output_max" not in r:
+        return ()
+    return (r.get("requests"), r.get("seed"), r.get("arrival"),
+            r.get("sessions"), r["output_min"], r["output_max"])
+
+
 def compare(base: Dict[str, Any], new: Dict[str, Any],
             tolerance: float = DEFAULT_TOLERANCE,
             abs_floor: float = DEFAULT_ABS_FLOOR,
@@ -122,6 +148,22 @@ def compare(base: Dict[str, Any], new: Dict[str, Any],
     n = _flatten(_unwrap(new))
     regressions: List[str] = []
     notes: List[str] = []
+    bi, ni = _trace_identity(base), _trace_identity(new)
+    if (bi is not None or ni is not None) and (not bi or bi != ni):
+        # Workload records whose traces differ (or predate the cap
+        # keys): tok_s depends on the trace's token budget, not the
+        # server, so pairing it would gate noise. Drop those keys from
+        # BOTH sides — a ``--require tok_s`` then fails loudly as
+        # not-comparable instead of comparing apples to oranges.
+        dropped = sorted(k for k in set(b) | set(n) if "tok_s" in k)
+        for k in dropped:
+            b.pop(k, None)
+            n.pop(k, None)
+        if dropped:
+            notes.append(
+                f"unpaired   tok_s ({len(dropped)} key(s)) not gated: "
+                f"workload output-cap identity differs or is "
+                f"unrecorded (base={bi}, new={ni})")
     for key in sorted(set(b) & set(n)):
         d = direction(key)
         if d is None:

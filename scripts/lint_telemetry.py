@@ -1,360 +1,61 @@
 #!/usr/bin/env python
-"""Static telemetry lint (ISSUE 3 satellite; the fast tier runs it via
-``tests/test_lint_telemetry.py``, or run it directly: prints violations
-and exits non-zero when any exist).
+"""Static telemetry lint — now a thin shim (ISSUE 8 satellite).
 
-Rule 1 — hot paths use ``time.perf_counter``, never ``time.time``:
-wall-clock jumps (NTP slews, suspend/resume) would corrupt latency
-histograms, deadlines and the pipelined-overlap accounting. Hot paths
-are the serving scheduler, the obs package itself, the fault probes, the
-jitted-step helpers, prefetch, and the kernels. Deliberate wall-clock
-users stay OFF this list: ``train/resilience.py`` stamps heartbeat files
-with epoch time for EXTERNAL watchdogs, and ``cli/serve.py``'s uptime is
-human-facing.
+The five rules born here (hot-path clocks, metric-name grammar +
+register-once, catalogue coverage, fault-site test coverage, bounded
+label cardinality) moved into the unified static-analysis framework as
+``eventgpt_tpu/analysis/telemetry_rules.py`` and run, alongside the
+lock-discipline / host-sync / jit-hygiene analyzers, via
+``scripts/egpt_check.py``. This shim keeps the legacy entry point and
+the ``run_lint(root) -> List[str]`` surface byte-compatible so
+``tests/test_lint_telemetry.py`` (and any operator muscle memory) keeps
+working: same violation strings, same exit semantics.
 
-Rule 2 — metric registration: every ``.counter(``/``.gauge(``/
-``.histogram(`` call with a string-literal name uses a name matching
-``egpt_[a-z0-9_]+``, and each name is registered exactly once across the
-runtime tree (the obs/metrics.py central-catalogue rule: call sites
-import metric objects, they never register). Tests are excluded — they
-build private registries with throwaway names.
-
-Rule 3 — catalogue coverage (ISSUE 4 satellite): every registered
-``egpt_*`` metric has a row in OBSERVABILITY.md (literal name mention).
-An operator hunting a dashboard number must find its meaning in the
-catalogue; a metric that ships undocumented "passes" silently forever.
-
-Rule 4 — fault-site test coverage (ISSUE 5 satellite): every
-``faults.maybe_fail``/``maybe_delay`` site name wired in the runtime
-tree (``eventgpt_tpu/``) appears, by literal name, in at least one
-chaos/faults test — a tests/ file that actually arms injection
-(``faults.configure(`` or ``EGPT_FAULTS``). A fault site nobody can
-reach from a test is exactly the dead handling code ``faults.py``
-exists to prevent.
-
-Rule 5 — bounded label cardinality (ISSUE 6 satellite): every labelled
-metric observation (``.inc(k=v)`` / ``.observe(x, k=v)`` /
-``.set(x, k=v)`` on a catalogued metric object) draws its label values
-from the fixed enum declared in the catalogue
-(``obs/metrics.py::METRIC_LABELS`` — a pure literal this lint reads
-with ``ast.literal_eval``). Violations: a label key with no declared
-enum, a literal value outside the enum, a computed value (f-string /
-str()/format — the unbounded shapes), a numeric literal, or a
-request-id-shaped label key (``rid``/``id``/...). Additionally every
-fault site found by rule 4's scan must be a member of
-``egpt_fault_trips_total``'s ``site`` enum, so a new site cannot ship
-without extending it. The metric classes re-enforce the enums at
-observe time; this rule catches the violation before anything runs.
+Rule catalogue, annotation and waiver grammar: OBSERVABILITY.md
+"Static analysis".
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
-from typing import Dict, List
+from typing import List
 
-HOT_PATHS = (
-    "eventgpt_tpu/serve.py",
-    "eventgpt_tpu/faults.py",
-    "eventgpt_tpu/obs/",
-    "eventgpt_tpu/train/steps.py",
-    "eventgpt_tpu/train/prefetch.py",
-    "eventgpt_tpu/ops/",
-)
-# Trees scanned for metric registrations (rule 2). tests/ is excluded on
-# purpose: private test registries use throwaway names.
-METRIC_SCAN = ("eventgpt_tpu", "scripts", "bench.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
-METRIC_NAME_RE = re.compile(r"^egpt_[a-z0-9_]+$")
-_REG_RE = re.compile(
-    r"\.(?:counter|gauge|histogram)\(\s*['\"]([A-Za-z0-9_.:-]+)['\"]")
-# Rule 4: fault-probe call sites in the runtime tree (string-literal
-# site names only — the grammar faults.py documents).
-_FAULT_SITE_RE = re.compile(
-    r"maybe_(?:fail|delay)\(\s*['\"]([A-Za-z0-9_.]+)['\"]")
-# A tests/ file counts as a chaos/faults test iff it arms injection.
-_FAULT_TEST_RE = re.compile(r"faults\.configure\(|EGPT_FAULTS")
-# Rule 5: metric observation methods (labels arrive as kwargs) and the
-# non-label kwargs they accept; label keys that smell like per-request
-# identity are banned outright, whatever their values.
-_OBS_METHODS = ("inc", "observe", "set")
-_NON_LABEL_KWARGS = ("n",)
-_BANNED_LABEL_KEYS = ("rid", "request_id", "req_id", "id", "uid",
-                      "user", "user_id", "session_id")
-
-
-def _is_hot(rel: str) -> bool:
-    return any(rel == h or (h.endswith("/") and rel.startswith(h))
-               for h in HOT_PATHS)
-
-
-def _py_files(root: str) -> List[str]:
-    out = []
-    for scan in METRIC_SCAN:
-        p = os.path.join(root, scan)
-        if os.path.isfile(p):
-            out.append(p)
-            continue
-        for dirpath, _, files in os.walk(p):
-            out.extend(os.path.join(dirpath, f) for f in sorted(files)
-                       if f.endswith(".py"))
-    return sorted(out)
-
-
-def _check_time_time(rel: str, tree: ast.AST, out: List[str]) -> None:
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "time"
-                and isinstance(node.func.value, ast.Name)
-                and node.func.value.id == "time"):
-            out.append(f"{rel}:{node.lineno}: time.time() in a hot path "
-                       f"(use time.perf_counter)")
-        if (isinstance(node, ast.ImportFrom) and node.module == "time"
-                and any(a.name == "time" for a in node.names)):
-            out.append(f"{rel}:{node.lineno}: 'from time import time' in "
-                       f"a hot path (use time.perf_counter)")
+# Import the package (not just telemetry_rules) so every rule id is
+# registered before waiver validation runs — a lock/hot-sync waiver in
+# the tree must not read as "unknown rule" to a telemetry-only pass.
+from eventgpt_tpu.analysis import TELEMETRY_RULES
+from eventgpt_tpu.analysis.core import load_sources, run_checks
 
 
 def run_lint(root: str) -> List[str]:
-    """Returns the violation list (empty = clean)."""
-    violations: List[str] = []
-    seen: Dict[str, str] = {}  # metric name -> first registration site
-    parsed: List[tuple] = []   # (rel, src, tree) for the AST passes
-    for path in _py_files(root):
-        rel = os.path.relpath(path, root).replace(os.sep, "/")
-        with open(path) as f:
-            src = f.read()
-        try:
-            tree = ast.parse(src, rel)
-        except SyntaxError as e:
-            violations.append(f"{rel}: unparseable ({e})")
+    """Returns the violation list (empty = clean) — the legacy string
+    form (``file:line: message``). Waivers apply as everywhere in the
+    framework; only unwaived findings are violations."""
+    findings = run_checks(root, TELEMETRY_RULES,
+                          sources=load_sources(root))
+    out: List[str] = []
+    for f in findings:
+        if f.waived:
             continue
-        parsed.append((rel, src, tree))
-        if _is_hot(rel):
-            _check_time_time(rel, tree, violations)
-        for m in _REG_RE.finditer(src):
-            # \s crosses newlines: registrations wrap the name to the
-            # line after the call in the catalogue's house style.
-            name = m.group(1)
-            site = f"{rel}:{src.count(chr(10), 0, m.start()) + 1}"
-            if not METRIC_NAME_RE.match(name):
-                violations.append(
-                    f"{site}: metric name {name!r} does not match "
-                    f"{METRIC_NAME_RE.pattern}")
-            if name in seen:
-                violations.append(
-                    f"{site}: metric {name!r} registered twice "
-                    f"(first at {seen[name]}) — define metrics once, "
-                    f"in obs/metrics.py")
-            else:
-                seen[name] = site
-    if not seen:
-        violations.append("no metric registrations found — the scan "
-                          "pattern or tree layout changed under the lint")
-    _check_catalogue(root, seen, violations)
-    fault_sites = _check_fault_coverage(root, violations)
-    _check_label_enums(parsed, fault_sites, violations)
-    return violations
-
-
-def _metric_var_map(parsed: List[tuple]) -> Dict[str, str]:
-    """Assignment targets bound to a metric registration, anywhere in
-    the scanned tree — how rule 5 resolves an observation's receiver
-    (``SERVE_TTFT.observe`` / ``obs_metrics.SERVE_TTFT.observe``) back
-    to its catalogue entry."""
-    out: Dict[str, str] = {}
-    for _rel, _src, tree in parsed:
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Assign)
-                    and isinstance(node.value, ast.Call)
-                    and isinstance(node.value.func, ast.Attribute)
-                    and node.value.func.attr in ("counter", "gauge",
-                                                 "histogram")
-                    and node.value.args
-                    and isinstance(node.value.args[0], ast.Constant)
-                    and isinstance(node.value.args[0].value, str)):
-                continue
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Name):
-                    out[tgt.id] = node.value.args[0].value
+        if f.rule == "waiver":
+            # The legacy surface predates waivers: report malformed
+            # waiver comments too (a silent suppression is worse).
+            out.append(f"{f.file}:{f.line}: {f.message}")
+        elif not f.file:
+            out.append(f.message)
+        elif not f.line:
+            out.append(f"{f.file}: {f.message}")
+        else:
+            out.append(f"{f.file}:{f.line}: {f.message}")
     return out
 
 
-def _metric_label_enums(parsed: List[tuple]) -> Dict[str, Dict[str, tuple]]:
-    """``METRIC_LABELS`` from obs/metrics.py — the declared enum
-    catalogue, read statically (it is a pure literal by contract)."""
-    for rel, _src, tree in parsed:
-        if not rel.endswith("obs/metrics.py"):
-            continue
-        for node in ast.walk(tree):
-            if (isinstance(node, ast.Assign)
-                    and any(isinstance(t, ast.Name)
-                            and t.id == "METRIC_LABELS"
-                            for t in node.targets)):
-                try:
-                    return ast.literal_eval(node.value)
-                except ValueError:
-                    return {}
-    return {}
-
-
-def _literal_label_values(node: ast.AST) -> List[str]:
-    """String literals an observation's label kwarg can evaluate to:
-    a Constant, or both arms of a conditional expression ('true' if ok
-    else 'false'). Empty = not statically resolvable."""
-    if isinstance(node, ast.Constant):
-        return [node.value] if isinstance(node.value, str) else []
-    if isinstance(node, ast.IfExp):
-        return (_literal_label_values(node.body)
-                + _literal_label_values(node.orelse))
-    return []
-
-
-def _check_label_enums(parsed: List[tuple], fault_sites: Dict[str, str],
-                       violations: List[str]) -> None:
-    """Rule 5: labelled observations stay inside the declared enums."""
-    var_map = _metric_var_map(parsed)
-    enums = _metric_label_enums(parsed)
-    for rel, _src, tree in parsed:
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _OBS_METHODS):
-                continue
-            recv = node.func.value
-            var = (recv.id if isinstance(recv, ast.Name)
-                   else recv.attr if isinstance(recv, ast.Attribute)
-                   else None)
-            metric = var_map.get(var or "")
-            if metric is None:
-                continue  # not a metric object (Event.set, queue, ...)
-            site = f"{rel}:{node.lineno}"
-            declared = enums.get(metric, {})
-            for kw in node.keywords:
-                if kw.arg is None or kw.arg in _NON_LABEL_KWARGS:
-                    continue
-                if kw.arg in _BANNED_LABEL_KEYS:
-                    violations.append(
-                        f"{site}: metric {metric!r} labelled with "
-                        f"{kw.arg!r} — per-request identity labels are "
-                        f"unbounded cardinality, banned outright")
-                    continue
-                allowed = declared.get(kw.arg)
-                if allowed is None:
-                    violations.append(
-                        f"{site}: metric {metric!r} label {kw.arg!r} has "
-                        f"no declared enum in obs/metrics.py "
-                        f"METRIC_LABELS — labelled observations must "
-                        f"draw values from a fixed catalogue enum")
-                    continue
-                if isinstance(kw.value, ast.JoinedStr) or (
-                        isinstance(kw.value, ast.Call)
-                        and isinstance(kw.value.func, ast.Name)
-                        and kw.value.func.id in ("str", "repr", "format")):
-                    violations.append(
-                        f"{site}: metric {metric!r} label {kw.arg!r} is "
-                        f"computed (f-string/str()) — unbounded label "
-                        f"values are banned; use an enum member")
-                    continue
-                if (isinstance(kw.value, ast.Constant)
-                        and not isinstance(kw.value.value, str)):
-                    violations.append(
-                        f"{site}: metric {metric!r} label {kw.arg!r} is "
-                        f"the non-string literal {kw.value.value!r} — "
-                        f"request-id-shaped labels are banned")
-                    continue
-                for lit in _literal_label_values(kw.value):
-                    if lit not in allowed:
-                        violations.append(
-                            f"{site}: metric {metric!r} label "
-                            f"{kw.arg!r}={lit!r} outside the declared "
-                            f"enum {tuple(allowed)}")
-                # Plain names/attributes pass statically; the metric
-                # classes validate them against the same enum at
-                # observe time (obs/metrics.py _key).
-    # The fault-trip site label must enumerate every wired site: a new
-    # maybe_fail site without an enum entry would raise at first trip.
-    trip_sites = enums.get("egpt_fault_trips_total", {}).get("site")
-    if trip_sites is not None:
-        for name, site in sorted(fault_sites.items()):
-            if name not in trip_sites:
-                violations.append(
-                    f"{site}: fault site {name!r} missing from "
-                    f"egpt_fault_trips_total's site enum "
-                    f"(obs/metrics.py METRIC_LABELS) — its first trip "
-                    f"would raise at observe time")
-
-
-def _check_fault_coverage(root: str,
-                          violations: List[str]) -> Dict[str, str]:
-    """Rule 4: every wired fault site is reachable from a chaos/faults
-    test (its literal name appears in a tests/ file that arms
-    injection). The example spec in faults.py's own docstring names real
-    sites, which is fine — they must be covered anyway. Returns the
-    site -> first-wiring-site map (rule 5 cross-checks it against the
-    egpt_fault_trips_total label enum)."""
-    sites: Dict[str, str] = {}
-    pkg = os.path.join(root, "eventgpt_tpu")
-    for dirpath, _, files in os.walk(pkg):
-        for f in sorted(files):
-            if not f.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, f)
-            rel = os.path.relpath(path, root).replace(os.sep, "/")
-            with open(path) as fh:
-                src = fh.read()
-            for m in _FAULT_SITE_RE.finditer(src):
-                sites.setdefault(
-                    m.group(1),
-                    f"{rel}:{src.count(chr(10), 0, m.start()) + 1}")
-    chaos_text = []
-    tests = os.path.join(root, "tests")
-    if os.path.isdir(tests):
-        for f in sorted(os.listdir(tests)):
-            if not f.endswith(".py"):
-                continue
-            with open(os.path.join(tests, f)) as fh:
-                src = fh.read()
-            if _FAULT_TEST_RE.search(src):
-                chaos_text.append(src)
-    blob = "\n".join(chaos_text)
-    if not sites:
-        if os.path.isdir(pkg):
-            violations.append("no fault sites found under eventgpt_tpu/ — "
-                              "the scan pattern changed under the lint")
-        return sites
-    for name, site in sorted(sites.items()):
-        if name not in blob:
-            violations.append(
-                f"{site}: fault site {name!r} is not exercised by any "
-                f"chaos/faults test (no tests/ file arming injection "
-                f"mentions it) — unreachable failure handling rots")
-    return sites
-
-
-def _check_catalogue(root: str, seen: Dict[str, str],
-                     violations: List[str]) -> None:
-    """Rule 3: every registered egpt_* metric appears (by literal name)
-    in OBSERVABILITY.md's catalogue."""
-    doc_path = os.path.join(root, "OBSERVABILITY.md")
-    try:
-        with open(doc_path) as f:
-            doc = f.read()
-    except OSError:
-        doc = ""
-    for name, site in sorted(seen.items()):
-        if METRIC_NAME_RE.match(name) and name not in doc:
-            violations.append(
-                f"{site}: metric {name!r} has no catalogue row in "
-                f"OBSERVABILITY.md — document every registered metric")
-
-
 def main() -> int:
-    root = sys.argv[1] if len(sys.argv) > 1 else os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))
+    root = sys.argv[1] if len(sys.argv) > 1 else _REPO
     violations = run_lint(root)
     for v in violations:
         print(v)

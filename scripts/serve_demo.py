@@ -5,6 +5,14 @@ The reference answers one request per process (``inference.py``); here
 requests join a running batch as rows free up — submit more queries than
 ``--max_batch`` and watch them stream through without a batch drain.
 
+Threading note (audited by ``scripts/egpt_check.py``, ISSUE 8): this
+demo drives the ``ContinuousBatcher`` from the main thread only —
+consistent with the batcher's ``_EXTERNAL_LOCK`` single-owner contract
+(here the owner is simply this script; no engine, no lock needed).
+``scripts/`` is inside the suite's scan set, so a future edit that
+spawns a thread around the batcher or mints an untracked jit gets
+flagged, not merged.
+
 Usage (offline smoke, tiny random weights):
   python scripts/serve_demo.py --event_frame /root/reference/samples/sample1.npy \
       --queries "What is happening?;Describe the scene.;What moves fastest?" \

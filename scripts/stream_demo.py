@@ -16,6 +16,13 @@ Usage:
 Without --events, a structured npy is synthesized from the reference's
 sample1.npy (whose on-disk form is a pickled dict the native reader
 deliberately does not parse).
+
+Threading note (audited by ``scripts/egpt_check.py``, ISSUE 8): the
+only concurrency here lives INSIDE the native reader (its own C++
+consumer thread behind the ctypes seam); the Python side runs the
+rasterize -> CLIP -> LLM pipeline on the main thread with no shared
+mutable Python state — nothing for the lock-discipline rule to guard,
+and the scan keeps it that way.
 """
 
 from __future__ import annotations

@@ -50,6 +50,11 @@ def test_workload_artifacts_schema():
         assert rec["metric"].startswith("workload_goodput_"), p
         assert rec["unit"] == "req/s", p
         assert isinstance(rec["value"], (int, float)), p
+        # Output-cap identity keys (ISSUE 8 satellite): without them
+        # tok_s cannot pair across topologies — r01 shipped without
+        # them once and its tok_s was structurally skewed.
+        for k in ("output_min", "output_max", "trace_output_tokens"):
+            assert isinstance(rec.get(k), int), (p, k)
         sweep = rec["sweep"]
         assert len(sweep) >= 2, f"{p}: need >= 2 offered-load points"
         for leg in sweep:
@@ -99,18 +104,19 @@ def test_fleet_workload_artifact_schema():
 
 
 def test_compare_bench_gates_fleet_vs_single_workload():
-    """ISSUE 7 satellite: compare_bench is the tier-1 smoke gate over
-    the checked-in fleet artifact vs WORKLOAD_r01.json — direction-aware
-    keys only, pinned to the SLO-goodput keys (cross-topology tok_s /
-    latency pairing is skewed; OBSERVABILITY.md 'Fleet workload record'
-    documents the fleet-only keys that are never gated). Degrading the
-    fleet goodput must fire — the gate has teeth on these keys."""
+    """ISSUE 7/8 satellite: compare_bench is the tier-1 smoke gate over
+    the checked-in fleet artifact vs WORKLOAD_r01.json. Since ISSUE 8
+    both records carry the output-cap identity keys and were generated
+    from the SAME trace, so tok_s pairs across topologies and is GATED
+    — the pre-fix skew (r01's unrecorded caps implied ~1665 served
+    tokens vs the trace's 1151 budget) is regenerated away. Degrading
+    the fleet goodput must fire — the gate has teeth on these keys."""
     mod = _compare_mod()
     base = _load(os.path.join(ROOT, "WORKLOAD_r01.json"))
     new = _load(sorted(glob.glob(
         os.path.join(ROOT, "WORKLOAD_FLEET_r0*.json")))[0])
     require = ("goodput_rps", "slo_met_ratio", "attainment",
-               "prefix_cache_hit_ratio")
+               "prefix_cache_hit_ratio", "tok_s")
     regs, _ = mod.compare(base, new, require=require)
     assert regs == [], f"fleet artifact regressed the SLO-goodput " \
                        f"keys vs WORKLOAD_r01: {regs}"
@@ -119,6 +125,37 @@ def test_compare_bench_gates_fleet_vs_single_workload():
         leg["goodput_rps"] *= 0.5
     regs, _ = mod.compare(base, worse, require=require)
     assert any("goodput_rps" in r for r in regs)
+
+
+def test_compare_bench_tok_s_pairs_only_on_matching_output_caps():
+    """The ISSUE 8 contract: tok_s gates across workload records only
+    when their trace identity (output caps + seed/requests/arrival)
+    matches; a mismatched or unrecorded identity drops tok_s with a
+    note, and --require tok_s then fails loudly as not-comparable."""
+    mod = _compare_mod()
+    rec = _load(os.path.join(ROOT, "WORKLOAD_r01.json"))
+    # Same identity, degraded tok_s: must fire.
+    worse = json.loads(json.dumps(rec))
+    for leg in worse["sweep"]:
+        leg["tok_s"] *= 0.5
+    regs, _ = mod.compare(rec, worse, require=("tok_s",))
+    assert any("tok_s" in r for r in regs)
+    # Different output caps: the SAME degradation is not gated (the
+    # traces are different traffic) and tok_s is noted as unpaired.
+    worse["output_max"] = rec["output_max"] * 2
+    regs, notes = mod.compare(rec, worse)
+    assert not any("tok_s" in r for r in regs)
+    assert any("unpaired" in n and "tok_s" in n for n in notes)
+    # Requiring tok_s across unpairable records fails loudly.
+    regs, _ = mod.compare(rec, worse, require=("tok_s",))
+    assert any("not comparable" in r for r in regs)
+    # Records that predate the cap keys behave the same way.
+    legacy = json.loads(json.dumps(rec))
+    for k in ("output_min", "output_max", "trace_output_tokens"):
+        legacy.pop(k)
+    regs, notes = mod.compare(legacy, rec)
+    assert not any("tok_s" in r for r in regs)
+    assert any("unpaired" in n for n in notes)
 
 
 def test_compare_bench_gates_checked_in_rounds():

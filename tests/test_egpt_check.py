@@ -1,0 +1,387 @@
+"""egpt-check suite tests (ISSUE 8): every analyzer fires on a
+violating fixture, stays silent on a clean one, and honors waivers —
+plus the repo self-check: the LIVE tree passes with zero unwaived
+findings (this is also the regression test for every race the lock
+detector surfaced and this PR fixed: reverting a fix re-opens a
+finding and fails here). Fast tier."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from eventgpt_tpu.analysis import (ALL_RULES, run_checks, render_json,
+                                   unwaived)
+from eventgpt_tpu.analysis.hot_path import HotSyncRule
+from eventgpt_tpu.analysis.jit_hygiene import JitHygieneRule
+from eventgpt_tpu.analysis.lock_discipline import LockDisciplineRule
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(root, rules):
+    return run_checks(str(root), rules)
+
+
+def _pkg(tmp_path):
+    pkg = tmp_path / "eventgpt_tpu"
+    pkg.mkdir()
+    return pkg
+
+
+# -- repo self-check ------------------------------------------------------
+
+def test_repo_self_check_zero_unwaived_findings():
+    """The acceptance bar: all 8+ rules over the live tree, clean.
+    Reverting any lock/hot-sync/jit fix this PR made (engine fault
+    counters, fleet counter writes, faults.check lookup, metrics
+    _common, the multiproc per-call jit, ...) re-opens a finding
+    here."""
+    findings = _run(ROOT, ALL_RULES)
+    assert unwaived(findings) == [], "\n".join(
+        f.render() for f in unwaived(findings))
+
+
+def test_repo_waivers_all_carry_reasons():
+    """Every waiver in the shipped tree is justified in-source (the
+    doc satellite lists them; an unexplained suppression is itself a
+    finding, so this holds by construction — asserted anyway)."""
+    findings = _run(ROOT, ALL_RULES)
+    waived = [f for f in findings if f.waived]
+    assert waived, "expected the tree's documented waivers to be seen"
+    assert all(f.waiver_reason for f in waived)
+
+
+def test_runner_cli_and_json_mode(tmp_path):
+    """scripts/egpt_check.py: exit 0 + per-rule counts on a clean tree,
+    exit 1 on a violating one; --json is machine-diffable (the CI
+    satellite)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "egpt_check", os.path.join(ROOT, "scripts", "egpt_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([ROOT]) == 0
+    pkg = _pkg(tmp_path)
+    (pkg / "bad.py").write_text("import time\n")
+    # A violating tree: unguarded write against a declared lock.
+    (pkg / "x.py").write_text(
+        "import threading\n"
+        "class C:\n"
+        "    _GUARDED_BY = {'_q': '_lock'}\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = []\n"
+        "    def f(self):\n"
+        "        self._q.append(1)\n")
+    assert mod.main([str(tmp_path)]) == 1
+    report = json.loads(render_json(_run(tmp_path, ALL_RULES), ALL_RULES))
+    assert report["counts"]["lock"] >= 1
+    assert {"rule", "file", "line", "message"} <= set(
+        report["findings"][0])
+
+
+# -- lock discipline ------------------------------------------------------
+
+LOCK_FIXTURE = """\
+import threading
+
+
+class Engine:
+    _GUARDED_BY = {{"_consec": "_lock/w", "_answers": "_lock"}}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._consec = 0
+        self._answers = {{}}
+
+    def on_fault(self):
+        {fault_line}
+        with self._lock:
+            self._answers["x"] = 1
+
+    def read(self):
+        return self._consec  # /w: lock-free read is the contract
+
+    def _sweep_locked(self):
+        self._answers.clear()
+
+    def caller(self):
+        {call_line}
+"""
+
+
+def test_lock_rule_fires_on_each_violation_class(tmp_path):
+    pkg = _pkg(tmp_path)
+    (pkg / "x.py").write_text(LOCK_FIXTURE.format(
+        fault_line="self._consec += 1",
+        call_line="self._sweep_locked()"))
+    msgs = [f.message for f in _run(tmp_path, [LockDisciplineRule()])
+            if not f.waived]
+    assert any("write to guarded attribute 'self._consec'" in m
+               for m in msgs)
+    assert any("'self._sweep_locked()' outside lock scope" in m
+               for m in msgs)
+    # The /w read and the *_locked body itself stay clean.
+    assert not any("read of guarded attribute 'self._consec'" in m
+                   for m in msgs)
+    assert not any("_answers.clear" in m for m in msgs)
+
+
+def test_lock_rule_clean_fixture(tmp_path):
+    pkg = _pkg(tmp_path)
+    (pkg / "x.py").write_text(LOCK_FIXTURE.format(
+        fault_line="with self._lock:\n            self._consec += 1",
+        call_line="with self._lock:\n            self._sweep_locked()"))
+    assert [f for f in _run(tmp_path, [LockDisciplineRule()])
+            if not f.waived and f.rule == "lock"] == []
+
+
+def test_lock_rule_waiver(tmp_path):
+    pkg = _pkg(tmp_path)
+    (pkg / "x.py").write_text(LOCK_FIXTURE.format(
+        fault_line="self._consec += 1  "
+                   "# egpt-check: ignore[lock] -- GIL-atomic bump, "
+                   "sole writer",
+        call_line="with self._lock:\n            self._sweep_locked()"))
+    fs = _run(tmp_path, [LockDisciplineRule()])
+    assert [f for f in fs if not f.waived and f.rule == "lock"] == []
+    waived = [f for f in fs if f.waived]
+    assert len(waived) == 1 and "GIL-atomic" in waived[0].waiver_reason
+
+
+def test_lock_rule_locked_method_retaking_lock_is_deadlock(tmp_path):
+    pkg = _pkg(tmp_path)
+    (pkg / "x.py").write_text(
+        "import threading\n"
+        "class C:\n"
+        "    _GUARDED_BY = {'_q': '_lock'}\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = []\n"
+        "    def _pop_locked(self):\n"
+        "        with self._lock:\n"
+        "            return self._q.pop()\n")
+    msgs = [f.message for f in _run(tmp_path, [LockDisciplineRule()])]
+    assert any("deadlock" in m for m in msgs)
+
+
+def test_lock_rule_external_lock_contract(tmp_path):
+    """_EXTERNAL_LOCK (the ContinuousBatcher annotation): the class must
+    not manufacture its own concurrency."""
+    pkg = _pkg(tmp_path)
+    (pkg / "x.py").write_text(
+        "import threading\n"
+        "class Batcher:\n"
+        "    _EXTERNAL_LOCK = 'Engine._lock'\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        t = threading.Thread(target=self.run)\n")
+    msgs = [f.message for f in _run(tmp_path, [LockDisciplineRule()])]
+    assert any("spawns its own thread" in m for m in msgs)
+    assert any("creates its own lock" in m for m in msgs)
+
+
+# -- host-sync hot path ---------------------------------------------------
+
+HOT_FIXTURE = """\
+import numpy as np
+import jax
+
+
+def _segment(x):
+    return x + 1
+
+
+_segment_jit = _segment
+
+
+class Batcher:
+    _HOT_ROOTS = ("step",)
+
+    def step(self):
+        self._dispatch()
+        self._harvest(None)
+
+    def _dispatch(self):
+        out = _segment_jit(1)
+        {dispatch_line}
+        return out
+
+    {harvest_marker}def _harvest(self, rec):
+        return np.asarray(jax.device_get(rec))
+
+    def cold_path(self):
+        return float(np.asarray([1]).sum())  # unreachable from roots
+"""
+
+
+def test_hot_sync_rule_fires_and_harvest_annotation_exempts(tmp_path):
+    pkg = _pkg(tmp_path)
+    (pkg / "x.py").write_text(HOT_FIXTURE.format(
+        dispatch_line="bad = out.item()",
+        harvest_marker=""))
+    msgs = [f.message for f in _run(tmp_path, [HotSyncRule()])
+            if not f.waived]
+    assert any("'_dispatch'" in m and ".item()" in m for m in msgs)
+    # _harvest is reachable and UNannotated here: it must fire too.
+    assert any("'_harvest'" in m for m in msgs)
+    # cold_path is not reachable from the declared roots: silent.
+    assert not any("cold_path" in m for m in msgs)
+
+    (pkg / "x.py").write_text(HOT_FIXTURE.format(
+        dispatch_line="pass",
+        harvest_marker="# egpt-check: harvest -- designed blocking "
+                       "fetch of a settled segment\n    "))
+    clean = [f for f in _run(tmp_path, [HotSyncRule()])
+             if not f.waived and f.rule == "hot-sync"]
+    assert clean == [], [f.render() for f in clean]
+
+
+def test_hot_sync_waiver_and_host_container_args(tmp_path):
+    pkg = _pkg(tmp_path)
+    (pkg / "x.py").write_text(
+        "import numpy as np\n"
+        "class B:\n"
+        "    _HOT_ROOTS = ('step',)\n"
+        "    def step(self):\n"
+        "        a = np.asarray([t for t in (1, 2)])\n"  # host list: ok
+        "        # egpt-check: ignore[hot-sync] -- pixels are host "
+        "numpy by contract\n"
+        "        b = np.asarray(a, np.float32)\n"
+        "        return a, b\n")
+    fs = _run(tmp_path, [HotSyncRule()])
+    assert [f for f in fs if not f.waived and f.rule == "hot-sync"] == []
+    assert any(f.waived for f in fs)
+
+
+# -- jit hygiene ----------------------------------------------------------
+
+def test_jit_rule_fires_on_each_violation_class(tmp_path):
+    pkg = _pkg(tmp_path)
+    (pkg / "x.py").write_text(
+        "import functools\n"
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"                       # bare decorator, module scope
+        "def f(x):\n"
+        "    return x\n"
+        "\n"
+        "def g(sh):\n"
+        "    return jax.jit(lambda v: v)(sh)\n"   # untracked, per call
+        "\n"
+        "def h(items):\n"
+        "    for it in items:\n"
+        "        fn = jax.jit(lambda v: v, static_argnames=())\n"
+        "    return fn\n")
+    msgs = [f.message for f in _run(tmp_path, [JitHygieneRule()])
+            if not f.waived]
+    assert any("bare jax.jit at module scope" in m for m in msgs)
+    assert any("untracked executable creation" in m for m in msgs)
+    assert any("inside a loop" in m for m in msgs)
+
+
+def test_jit_rule_clean_patterns(tmp_path):
+    pkg = _pkg(tmp_path)
+    (pkg / "x.py").write_text(
+        "import functools\n"
+        "import jax\n"
+        "\n"
+        "@functools.partial(jax.jit, static_argnames=('k',))\n"
+        "def f(x, k):\n"
+        "    return x\n"
+        "\n"
+        "_g = functools.partial(jax.jit, donate_argnums=(0,))(f)\n"
+        "\n"
+        "@functools.lru_cache(maxsize=8)\n"
+        "def _get_sharded(bucket):\n"
+        "    return jax.jit(lambda v: v + bucket)\n"   # closure = config
+        "\n"
+        "def make_step(donate):\n"
+        "    @functools.partial(jax.jit, static_argnames=(),\n"
+        "                       donate_argnums=(0,) if donate else ())\n"
+        "    def step(s, b):\n"
+        "        return s\n"
+        "    return step\n")
+    bad = [f for f in _run(tmp_path, [JitHygieneRule()])
+           if not f.waived and f.rule == "jit-cache"]
+    assert bad == [], [f.render() for f in bad]
+
+
+# -- waiver machinery -----------------------------------------------------
+
+def test_malformed_waivers_are_findings(tmp_path):
+    pkg = _pkg(tmp_path)
+    (pkg / "x.py").write_text(
+        "A = 1  # egpt-check: ignore[lock]\n"
+        "B = 2  # egpt-check: ignore[made-up-rule] -- because\n")
+    msgs = [f.message for f in _run(tmp_path, ALL_RULES)
+            if f.rule == "waiver"]
+    assert any("without a justification" in m for m in msgs)
+    assert any("unknown rule" in m for m in msgs)
+
+
+# -- the race the detector caught (regression for the fix) ----------------
+
+class _SpyLock:
+    """Context manager proxy recording the engine's fault-streak value
+    at every acquire/release — proves the counter mutation happens
+    INSIDE the critical section, not before it (the pre-fix bug:
+    _on_fault bumped the breaker counters lock-free while revive()
+    zeroed them under the lock — a lost update could eat the trip that
+    opens the breaker)."""
+
+    def __init__(self, engine):
+        self._engine = engine
+        self._real = threading.Lock()
+        self.events = []
+
+    def __enter__(self):
+        self._real.acquire()
+        self.events.append(("enter", self._engine._consec_faults))
+        return self
+
+    def __exit__(self, *exc):
+        self.events.append(("exit", self._engine._consec_faults))
+        self._real.release()
+        return False
+
+
+@pytest.mark.parametrize("faults_before", [0, 1])
+def test_engine_fault_counters_mutate_under_the_lock(tiny_engine,
+                                                     faults_before):
+    eng = tiny_engine
+    eng._consec_faults = faults_before
+    spy = _SpyLock(eng)
+    eng._lock = spy
+    try:
+        eng._on_fault(RuntimeError("injected"))
+    finally:
+        eng._lock = threading.Lock()
+    # First acquire must see the PRE-fault value (nothing mutated
+    # outside the lock), and some release must see the bump.
+    assert spy.events[0] == ("enter", faults_before)
+    assert ("exit", faults_before + 1) in spy.events
+    assert eng._consec_faults == faults_before + 1
+    assert eng.n_faults >= 1 and eng.fault is not None
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    import jax
+
+    from eventgpt_tpu.cli.serve import ServingEngine
+    from eventgpt_tpu.config import EventChatConfig
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.models import eventchat
+    from eventgpt_tpu.serve import ContinuousBatcher
+
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    srv = ContinuousBatcher(params, cfg, max_batch=1, chunk=2,
+                            max_len=256, eos_token_id=None)
+    eng = ServingEngine(srv, load_tokenizer("byte"))
+    yield eng
+    eng.shutdown()
