@@ -168,7 +168,14 @@ def run_decode(args):
     _sync(ev)
     t_encode_compile = time.perf_counter() - t0
 
-    def measure(batch: int, kv: str):
+    def measure(batch: int, kv: str, phase_box: dict = None):
+        # ``phase_box`` (ISSUE 9): records which PHASE an OOM escapes
+        # from — "compile" until the decode loop's first call (XLA
+        # compile + first dispatch at the new shapes) has synced,
+        # "runtime" for the measured steady-state run — so the batch
+        # sweep can capture OOM as data instead of a dead leg.
+        if phase_box is not None:
+            phase_box["phase"] = "compile"
         embeds = [
             splice_embeddings(params, cfg, split_at_event(ids), ev[0])
             for _ in range(batch)
@@ -204,6 +211,8 @@ def run_decode(args):
 
         toks, _ = loop(last, cache)  # compile
         _sync(toks)
+        if phase_box is not None:
+            phase_box["phase"] = "runtime"
 
         t0 = time.perf_counter()
         last2, cache2 = prefill_once()
@@ -241,6 +250,21 @@ def run_decode(args):
                         "Ran out of memory"))
 
         sweep, sweep_kv, sweep_retries = {}, {}, {}
+        sweep_oom, sweep_est = {}, {}
+        # Closed-form resident-bytes estimate per point (ISSUE 9): the
+        # bytes-vs-batch curve PERFORMANCE.md "Batch scaling" needed —
+        # weights + B dense rows at the leg's cache length, per KV
+        # storage. The measured ceilings (b40 runtime / b48 compile on
+        # 16 GB) are what the capacity model must predict.
+        from eventgpt_tpu.obs import memory as obs_memory
+
+        w_bytes = obs_memory.params_bytes(params)
+        est_cache_len = ((prompt_len + args.decode_tokens + 64) // 64) * 64
+
+        def point_est_bytes(b, kv):
+            pos = obs_memory.kv_pos_bytes(cfg, kv_quant=kv == "int8")
+            return w_bytes + b * (est_cache_len * pos + 4)
+
         # Monotonicity only holds among the sweep's own bf16 points; the
         # headline tok_s is a valid predecessor only for batch-1 bf16.
         prev = tok_s if (args.batch == 1 and args.kv == "bf16") else 0.0
@@ -248,8 +272,9 @@ def run_decode(args):
             # bf16 KV first; where the cache no longer fits the 16 GB chip,
             # int8 KV (half the footprint) is the product answer
             # (cli/eval.py --kv_cache int8) — record which one ran.
+            phase = {}
             try:
-                r, _, _ = measure(b, "bf16")
+                r, _, _ = measure(b, "bf16", phase)
                 if r < prev * 0.8:
                     # Aggregate decode throughput is monotone in batch on
                     # this chip; a point far below its predecessor is a
@@ -267,18 +292,34 @@ def run_decode(args):
                     r = max(r, r2)
                 prev = max(prev, r)
                 sweep[str(b)], sweep_kv[str(b)] = round(r, 2), "bf16"
+                sweep_est[str(b)] = point_est_bytes(b, "bf16")
             except Exception as e:
                 if not is_oom(e):
                     raise
+                # OOM is DATA, not a dead leg (ISSUE 9): record which
+                # phase each storage's attempt died in. "compile"
+                # covers XLA compile + the first dispatch at the new
+                # shapes (donated-buffer allocation happens there);
+                # "runtime" means the compiled executable OOMed on the
+                # measured steady-state run.
+                sweep_oom[str(b)] = {"bf16": phase.get("phase", "compile")}
                 try:
-                    r, _, _ = measure(b, "int8")
+                    phase = {}
+                    r, _, _ = measure(b, "int8", phase)
                     sweep[str(b)], sweep_kv[str(b)] = round(r, 2), "int8"
+                    sweep_est[str(b)] = point_est_bytes(b, "int8")
                 except Exception as e2:
                     if not is_oom(e2):
                         raise
                     sweep[str(b)], sweep_kv[str(b)] = "oom", "int8"
+                    sweep_oom[str(b)]["int8"] = phase.get("phase",
+                                                          "compile")
+                    sweep_est[str(b)] = point_est_bytes(b, "int8")
         extras["batch_sweep_tok_s"] = sweep
         extras["batch_sweep_kv"] = sweep_kv
+        extras["batch_sweep_est_bytes"] = sweep_est
+        if sweep_oom:
+            extras["batch_sweep_oom"] = sweep_oom
         if sweep_retries:
             extras["batch_sweep_retries"] = sweep_retries
 
@@ -500,6 +541,9 @@ def run_serve(args):
     srv.reset_serving_stats()  # exclude the warmup/first-request phase
     _fresh_cache()
     obs_metrics.REGISTRY.reset()  # same phase scoping for the registry
+    from eventgpt_tpu.obs import memory as obs_memory
+
+    obs_memory.LEDGER.reset_peak()  # peak scoped to the measured window
     # --serve_stagger varies per-request budgets so rows finish (and
     # admission boundaries land) at DIFFERENT segments — the traffic
     # shape where stall-free admission matters; synchronized budgets
@@ -523,6 +567,12 @@ def run_serve(args):
     tot = sum(len(out[r]) for r in rids)
     ttfts = np.array([srv.request_stats[r]["ttft_s"] for r in rids])
     lats = np.array([srv.request_stats[r]["latency_s"] for r in rids])
+    # Memory ledger (ISSUE 9): every serve point records where the
+    # bytes live — peak + component breakdown + the live-array
+    # reconcile + the compiled executable footprint warmup probed.
+    mem = obs_memory.LEDGER.summary()
+    mem["reconcile"] = obs_memory.LEDGER.reconcile()
+    mem["compiled"] = srv.compiled_footprint(probe=False)
     record = {
         "metric": f"serve_aggregate_{preset}",
         "value": round(tot / dt, 2),
@@ -578,6 +628,8 @@ def run_serve(args):
         "mixed_zero_token_boundaries": srv.mixed_zero_harvests,
         "mixed_prefill_tokens": srv.mixed_prefill_tokens,
         "first_request_s": round(t_first_req, 3),
+        "mem_peak_bytes": mem["peak_bytes"],
+        "memory": mem,
         "warmup": bool(args.warmup),
         "warmup_s": round(t_warm, 3),
         "warmed_executables": warmed,
@@ -728,6 +780,8 @@ def run_workload(args):
         # them; the measured legs then pay zero XLA compile.
         wl.replay(srv, trace, pixels_for=pixels_for, paced=False)
 
+    from eventgpt_tpu.obs import memory as obs_memory
+
     class_of = {r.idx: r.slo_class for r in trace}
     span = max(r.t_arrival for r in trace) or 1e-9
     mults = [float(x) for x in args.workload_mults.split(",") if x]
@@ -736,6 +790,7 @@ def run_workload(args):
         fresh_cache()
         srv.reset_serving_stats()
         obs_metrics.REGISTRY.reset()
+        obs_memory.LEDGER.reset_peak()  # per-point peak (ISSUE 9)
         res = wl.replay(srv, trace, pixels_for=pixels_for,
                         rate_mult=mult, paced=True, slo_for=slo_for)
         st = srv.slo_stats()
@@ -778,6 +833,15 @@ def run_workload(args):
             "admission_stall_s": round(srv.admission_s, 3),
             "mixed_boundaries": srv.mixed_boundaries,
             "mixed_zero_token_boundaries": srv.mixed_zero_harvests,
+            # Memory ledger (ISSUE 9): per-point peak + component
+            # breakdown + the accounted/unaccounted reconcile — the
+            # bytes column of the goodput story.
+            "mem_peak_bytes": obs_memory.LEDGER.summary()["peak_bytes"],
+            "memory": {
+                **{k: v for k, v in obs_memory.LEDGER.summary().items()
+                   if k in ("total_bytes", "peak_bytes", "components")},
+                "reconcile": obs_memory.LEDGER.reconcile(),
+            },
         }
         if args.serve_prefix_cache:
             leg["prefix_cache_hit_ratio"] = round(
@@ -907,6 +971,7 @@ def _run_workload_fleet(args, preset, cfg, platform, params, spec, trace):
     from eventgpt_tpu.cli.serve import ServingEngine
     from eventgpt_tpu.data.tokenizer import load_tokenizer
     from eventgpt_tpu.fleet import Fleet, FleetShedError
+    from eventgpt_tpu.obs import memory as obs_memory
     from eventgpt_tpu.obs import metrics as obs_metrics
     from eventgpt_tpu.serve import ContinuousBatcher, QueueFullError
 
@@ -990,6 +1055,7 @@ def _run_workload_fleet(args, preset, cfg, platform, params, spec, trace):
             if b._prefix_cache is not None and bool(args.serve_cache_insert):
                 b._prefix_cache = type(b._prefix_cache)(b._prefix_cache.budget)
         obs_metrics.REGISTRY.reset()
+        obs_memory.LEDGER.reset_peak()  # per-point peak (ISSUE 9)
 
     if args.warmup:
         # Cold-trajectory priming, fleet form: one unmeasured unpaced
@@ -1050,6 +1116,11 @@ def _run_workload_fleet(args, preset, cfg, platform, params, spec, trace):
                 "prefix_cache_hit_ratio": round(
                     rep.engine.batcher.prefix_cache_stats().get(
                         "hit_ratio", 0.0), 3),
+                # Per-replica resident share (ISSUE 9): this replica's
+                # OWN ledger components — weights are shared, counted
+                # once in the point-level memory summary.
+                "memory_bytes": sum(obs_memory.LEDGER.snapshot(
+                    rep.engine.batcher._mem_owner).values()),
             })
         hits = sum(r.engine.batcher.prefix_cache_stats().get("hits", 0)
                    for r in fleet.replicas)
@@ -1072,6 +1143,15 @@ def _run_workload_fleet(args, preset, cfg, platform, params, spec, trace):
             "rejected_total": res["rejected"],
             "failovers": fleet.n_failovers,
             "replicas": replicas,
+            # Process-wide ledger peak (N replicas + one shared weight
+            # tree — NOT comparable to a single-engine point's peak;
+            # OBSERVABILITY.md "Fleet workload record").
+            "mem_peak_bytes": obs_memory.LEDGER.summary()["peak_bytes"],
+            "memory": {
+                **{k: v for k, v in obs_memory.LEDGER.summary().items()
+                   if k in ("total_bytes", "peak_bytes", "components")},
+                "reconcile": obs_memory.LEDGER.reconcile(),
+            },
         })
 
     record = {

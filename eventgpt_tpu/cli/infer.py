@@ -245,6 +245,15 @@ def prepare_model(cfg, params, tokenizer, args, mesh=None):
         params = shard_params_for_serving(params, cfg, mesh, dtype=jdt)
     else:
         params = place_params(params, jdt)
+    # Memory ledger (ISSUE 9): the weight tree is device-resident from
+    # here on — attribute it at the load boundary so every CLI (infer/
+    # eval/serve) accounts it, not just the batcher (which registers
+    # the same tree under the same identity — a no-op resize).
+    from eventgpt_tpu.obs import memory as obs_memory
+
+    obs_memory.LEDGER.register(
+        "weights", f"shared/params-{id(params):x}",
+        obs_memory.params_bytes(params))
     return cfg, params
 
 

@@ -316,6 +316,13 @@ class ServingEngine:
             self._push_stream_deltas_locked()
             self._harvest_locked()
             recs = self.batcher.export_requests()
+            # export_requests settles the in-flight pipelined segment
+            # first (_drain), which can FINISH a request right here —
+            # after the harvest above, and out of rows so never
+            # exported. Harvest again or the answer strands in
+            # batcher.finished (the parked loop will not run again) and
+            # the fleet supervisor polls try_result forever.
+            self._harvest_locked()
             for rec in recs:
                 rid = rec["rid"]
                 self._done.pop(rid, None)
@@ -387,6 +394,12 @@ class ServingEngine:
             # SLO classes + windowed goodput (ISSUE 6): per-class
             # attainment so /stats carries the class alongside /metrics.
             "slo": b.slo_stats() if hasattr(b, "slo_stats") else {},
+            # Memory ledger (ISSUE 9): totals + per-component bytes +
+            # headroom-guard state, merged the way "slo" was — one
+            # /stats poll shows latency, goodput AND bytes. Host ints
+            # only (the jax.live_arrays reconcile lives on /memory).
+            "memory": (b.memory_summary()
+                       if hasattr(b, "memory_summary") else {}),
             **({"spec_tokens_per_iteration":
                 round(b.spec_tokens_per_iteration(), 2)}
                if b.speculative else {}),
@@ -398,6 +411,16 @@ class ServingEngine:
                 for k in itertools.islice(reversed(b.request_stats), 8)
             },
         }
+
+    def memory_stats(self) -> Dict[str, Any]:
+        """The ``GET /memory`` payload (ISSUE 9): ledger + fresh
+        live-array reconciliation + static estimate + compiled
+        footprint. Deliberately OUTSIDE the engine lock — the reconcile
+        walks every live buffer and a cold-probe compile can take
+        seconds; both read metadata/host state only, and the batcher's
+        memory surface takes no scheduler-owned mutable state."""
+        # egpt-check: ignore[lock] -- the batcher binding is set once in __init__ and never rebound; memory_stats reads its ledger/metadata surface only, and holding the engine lock across a live-array walk or an AOT compile would block the scheduler for seconds (the render-outside-the-lock rule /metrics follows)
+        return self.batcher.memory_stats()
 
     def stats(self) -> Dict[str, Any]:
         # Lock-free by design (see _snapshot); counters are GIL-atomic.
@@ -702,6 +725,13 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                 # health (ISSUE 7) — only mounted when the engine IS a
                 # fleet router (cli fleet mode).
                 self._json(200, engine.fleet_stats())
+            elif self.path == "/memory":
+                # HBM memory ledger (ISSUE 9): per-component bytes,
+                # jax.live_arrays reconciliation (accounted/unaccounted
+                # split), the static capacity estimate and the compiled
+                # executable footprint. Runs outside the engine lock
+                # like /metrics — pollable mid-segment.
+                self._json(200, engine.memory_stats())
             elif self.path == "/prefix_cache":
                 # Prefix-KV cache snapshot (ISSUE 4): entry list, byte
                 # budget/usage, hit/miss/eviction counters. Lock-free
@@ -1075,6 +1105,12 @@ def build_server(args) -> tuple:
                             if getattr(args, "prefill_budget", -1) < 0
                             else int(args.prefill_budget)),
             slo_window=int(getattr(args, "slo_window", 256)),
+            # Memory headroom guard (ISSUE 9): 0 disarms (the default);
+            # capacity 0 = the device's own reported limit.
+            mem_headroom_bytes=int(
+                getattr(args, "mem_headroom_mb", 0.0) * 1024 * 1024),
+            mem_capacity_bytes=int(
+                getattr(args, "mem_capacity_mb", 0.0) * 1024 * 1024),
         )
 
     def _make_engine(batcher, hb_dir):
@@ -1232,6 +1268,18 @@ def main(argv=None):
                    help="disable the prefix-KV cache entirely (every "
                         "admission full-prefills; the A/B escape hatch — "
                         "chains are byte-identical either way)")
+    # -- HBM memory ledger + admission headroom (ISSUE 9) --
+    p.add_argument("--mem_headroom_mb", type=float, default=0.0,
+                   help="admission headroom guard: defer admission "
+                        "waves while the memory ledger predicts the "
+                        "next wave would leave less than this many MB "
+                        "of device capacity free (0 = off, the A/B "
+                        "escape hatch; GET /memory shows the ledger)")
+    p.add_argument("--mem_capacity_mb", type=float, default=0.0,
+                   help="device capacity the headroom guard budgets "
+                        "against (0 = the device's own reported "
+                        "bytes_limit; CPU reports none, so set this "
+                        "explicitly there)")
     # -- request-lifecycle hardening (ISSUE 1) --
     p.add_argument("--max_queue", type=int, default=256,
                    help="admission-queue bound: submits beyond this get "

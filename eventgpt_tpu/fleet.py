@@ -79,6 +79,15 @@ class FleetShedError(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
+def _ledger_summary() -> Dict[str, Any]:
+    """Process memory-ledger totals for the fleet /stats poll (host
+    ints only; the import stays lazy like ``memory_stats`` so this
+    module keeps its jax-free import surface)."""
+    from eventgpt_tpu.obs import memory as obs_memory
+
+    return obs_memory.LEDGER.summary()
+
+
 def retry_after_s(slo_class: str, goodput_ratio: float = 1.0,
                   queue_depth: int = 0, max_queue: int = 0) -> float:
     """Class-aware 429 backoff derived from the CURRENT goodput window
@@ -423,6 +432,12 @@ class Fleet:
                 "prefix_cache_hit_ratio":
                     rep.engine.batcher.prefix_cache_stats().get(
                         "hit_ratio", 0.0),
+                # Per-replica memory share (ISSUE 9): this replica's
+                # OWN ledger components (resident cache, lanes, ...) —
+                # the shared weight tree lives in the process totals,
+                # not here (it is one allocation, not N).
+                "memory_bytes": sum(
+                    s.get("memory", {}).get("owner", {}).values()),
             })
         with self._lock:
             # _pins/n_shed are compound-mutated (full guard): snapshot
@@ -448,6 +463,11 @@ class Fleet:
             },
             "metrics": obs_metrics.REGISTRY.summary(
                 ("egpt_serve_", "egpt_fleet_")),
+            # Ledger totals ride the fleet poll too (ISSUE 9): one
+            # process, one jax runtime — the process ledger IS the
+            # fleet's memory story (per-replica shares are in
+            # per_replica[].memory_bytes above).
+            "memory": _ledger_summary(),
         }
 
     def fleet_stats(self) -> Dict[str, Any]:
@@ -464,6 +484,24 @@ class Fleet:
                 "replica_restart_s": self.replica_restart_s,
             },
         }
+
+    def memory_stats(self) -> Dict[str, Any]:
+        """The fleet ``GET /memory`` payload (ISSUE 9): process ledger
+        totals + reconciliation (one process, one jax runtime — the
+        ledger IS fleet-wide) plus each replica's own component share.
+        The weight tree appears once in the totals: replicas share it
+        by construction (one tree, N schedulers)."""
+        from eventgpt_tpu.obs import memory as obs_memory
+
+        out = obs_memory.LEDGER.summary()
+        out["reconcile"] = obs_memory.LEDGER.reconcile()
+        out["replicas"] = [
+            {"replica": rep.idx,
+             "components": obs_memory.LEDGER.snapshot(
+                 rep.engine.batcher._mem_owner)}
+            for rep in self.replicas
+        ]
+        return out
 
     def slo_stats(self) -> Dict[str, Any]:
         """Aggregate per-class attainment across replicas (the bench's

@@ -71,9 +71,17 @@ METRIC_LABELS = {
         # synthetic/ad-hoc drill sites (faults._site_label clamps).
         "site": ("fleet.probe", "fleet.replica_kill", "fleet.route",
                  "multiproc.launch", "multiproc.worker", "serve.admit",
-                 "serve.dispatch", "serve.loop", "serve.mixed_dispatch",
-                 "serve.prefix_copy", "serve.step", "train.step", "other"),
+                 "serve.dispatch", "serve.loop", "serve.mem_guard",
+                 "serve.mixed_dispatch", "serve.prefix_copy", "serve.step",
+                 "train.step", "other"),
         "kind": ("fail", "delay"),
+    },
+    "egpt_mem_component_bytes": {
+        # The memory ledger's component taxonomy (obs/memory.py
+        # COMPONENTS — keep the two literals identical; the ledger
+        # validates at register time, this enum at observe time).
+        "component": ("weights", "kv_cache", "logits", "ids_buf",
+                      "prefix_cache", "lanes", "draft", "carry", "other"),
     },
     "egpt_fleet_routed_total": {
         # Routing decisions (ISSUE 7): affinity = the session's pinned
@@ -588,6 +596,44 @@ FLEET_REPLICA_DEATHS = REGISTRY.counter(
     "egpt_fleet_replica_deaths_total",
     "Replica kills observed by the supervisor (chaos fleet.replica_kill "
     "trips and operator kill_replica calls)")
+
+# -- HBM memory ledger (ISSUE 9, eventgpt_tpu/obs/memory.py) --
+MEM_COMPONENT = REGISTRY.gauge(
+    "egpt_mem_component_bytes",
+    "Device bytes the memory ledger attributes to each named component "
+    "(weights / kv_cache / logits / ids_buf / prefix_cache / lanes / "
+    "draft / carry / other)")
+MEM_TOTAL = REGISTRY.gauge(
+    "egpt_mem_total_bytes",
+    "Sum of all ledger-registered device bytes (the accounted side of "
+    "the reconciliation split)")
+MEM_PEAK = REGISTRY.gauge(
+    "egpt_mem_peak_bytes",
+    "High-water mark of egpt_mem_total_bytes since the last "
+    "reset_peak() (phase-scoped, like reset_serving_stats)")
+MEM_LIVE = REGISTRY.gauge(
+    "egpt_mem_live_bytes",
+    "jax.live_arrays() device bytes at the last ledger reconcile "
+    "(GET /memory refreshes it)")
+MEM_UNACCOUNTED = REGISTRY.gauge(
+    "egpt_mem_unaccounted_bytes",
+    "live_bytes minus ledger total at the last reconcile - bytes no "
+    "component claims (transient admission caches, jit constants)")
+MEM_GUARD_DEFERRALS = REGISTRY.counter(
+    "egpt_mem_guard_deferrals_total",
+    "Admission waves deferred by the --mem_headroom_mb guard (the "
+    "ledger predicted the next wave would exceed capacity - headroom)")
+MEM_COMPILED_TEMP = REGISTRY.gauge(
+    "egpt_mem_compiled_temp_bytes",
+    "XLA temp allocation of the probed decode/spec segment executable "
+    "(compiled-footprint probe, lowered.compile().memory_analysis())")
+MEM_COMPILED_ARGUMENT = REGISTRY.gauge(
+    "egpt_mem_compiled_argument_bytes",
+    "XLA argument size of the probed segment executable (resident "
+    "buffers the dispatch reads; donated args alias into outputs)")
+MEM_COMPILED_OUTPUT = REGISTRY.gauge(
+    "egpt_mem_compiled_output_bytes",
+    "XLA output size of the probed segment executable")
 
 # -- fault injection (eventgpt_tpu/faults.py) --
 FAULT_TRIPS = REGISTRY.counter(

@@ -142,6 +142,32 @@ def _require_serving_mesh(mesh: Mesh) -> None:
         )
 
 
+def serving_divisors(num_kv_heads: int, mesh_shape, batch: int) -> dict:
+    """Per-device byte divisors of the serving layout, as pure
+    arithmetic on a ``{axis: size}`` mapping — THE sharding rules of
+    this module, exported for the memory ledger's capacity model
+    (``obs.memory.estimate``), which must fit-check a pod config
+    without building a Mesh or materializing a weight:
+
+      * ``batch``: the largest prefix of ``(data, fsdp)`` whose size
+        product divides the batch (``serving_batch_axes``);
+      * ``kv_heads``: ``model`` when it divides the KV head count
+        (``shard_kv_cache`` / ``prefix_block_sharding``);
+      * ``weights``: ``fsdp × model`` (``eventchat_param_specs``:
+        contraction dims over fsdp, head/column dims over model —
+        scales/norms replicate, a rounding the estimate absorbs).
+    """
+    batch_div = 1
+    for ax in ("data", "fsdp"):
+        n = int(mesh_shape.get(ax, 1))
+        if n > 1 and batch % (batch_div * n) == 0:
+            batch_div *= n
+    model_n = int(mesh_shape.get("model", 1))
+    head_div = model_n if model_n > 1 and num_kv_heads % model_n == 0 else 1
+    return {"batch": batch_div, "kv_heads": head_div,
+            "weights": int(mesh_shape.get("fsdp", 1)) * model_n}
+
+
 def serving_batch_axes(mesh: Mesh, batch: int) -> Tuple[str, ...]:
     """Largest prefix of ``(data, fsdp)`` whose size product divides
     ``batch`` — batch 1 on a wide mesh degrades to pure TP + weight

@@ -101,6 +101,7 @@ from jax import lax
 
 from eventgpt_tpu import faults
 from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.obs import memory as obs_memory
 from eventgpt_tpu.obs import metrics as obs_metrics
 from eventgpt_tpu.obs import profiling as obs_profiling
 from eventgpt_tpu.obs import trace as obs_trace
@@ -216,6 +217,11 @@ class PrefixCache:
         self.evictions = 0
         self.insertions = 0
         self._tick = 0
+        # Memory-ledger identity (ISSUE 9): this cache's entry bytes are
+        # one "prefix_cache" component entry, resized on insert/evict
+        # (lock order: PrefixCache._lock -> MemoryLedger._lock, leafward
+        # like the metric locks).
+        self._mem_key = f"pc{id(self):x}/entries"
 
     def _iter_nodes_locked(self):
         stack = [self._root]
@@ -320,6 +326,12 @@ class PrefixCache:
             # (metric locks are leaf locks — the order here is always
             # PrefixCache._lock -> _Metric._lock, never reversed).
             self._export_gauges_locked()
+            # Ledger resize rides the same critical section so the
+            # component bytes can never disagree with self.bytes
+            # (the spy-lock test in tests/test_memory_ledger.py holds
+            # the mutation inside it).
+            obs_memory.LEDGER.resize("prefix_cache", self._mem_key,
+                                     self.bytes)
         obs_metrics.SERVE_PREFIX_INSERTIONS.inc()
         return True
 
@@ -347,6 +359,16 @@ class PrefixCache:
     def _export_gauges_locked(self) -> None:
         obs_metrics.SERVE_PREFIX_BYTES.set(self.bytes)
         obs_metrics.SERVE_PREFIX_ENTRIES.set(self.n_entries)
+
+    def __del__(self):
+        # A replaced/dropped cache must not leave stale bytes in the
+        # memory ledger (the bench swaps in a fresh cache per measured
+        # point). Best-effort: interpreter teardown may have torn the
+        # ledger down first.
+        try:
+            obs_memory.LEDGER.release("prefix_cache", self._mem_key)
+        except Exception:
+            pass
 
     def stats(self) -> Dict[str, Any]:
         """Snapshot for ``GET /prefix_cache`` (lock-held, host-only)."""
@@ -1167,6 +1189,10 @@ class _Request:
     max_new_tokens: int
     tokens: List[int] = field(default_factory=list)
     row: int = -1
+    # Cache positions the prompt will occupy (text + event tokens) —
+    # computed once at submit; the memory headroom guard predicts the
+    # next admission wave's bytes from it without re-walking input_ids.
+    prompt_len: int = 0
     # Service timestamps (time.perf_counter at submit / first committed
     # token / completion) — the continuous-batching latency story: TTFT
     # and completion latency per request, aggregated by bench --mode serve.
@@ -1258,6 +1284,8 @@ class ContinuousBatcher:
         prefill_budget: int = 0,
         prefill_lane_chunk: int = 0,
         slo_window: int = 256,
+        mem_headroom_bytes: int = 0,
+        mem_capacity_bytes: int = 0,
     ):
         if prefill_chunk and (2 * SEQ_BUCKET) % prefill_chunk:
             # A chunk that does not divide the bucket grain would force
@@ -1452,7 +1480,80 @@ class ContinuousBatcher:
         # finishes, True per request that met every armed target — the
         # egpt_serve_slo_goodput_ratio gauge is their mean.
         self._slo_window_len = max(int(slo_window), 1)
+        # HBM memory ledger (ISSUE 9): attribute every resident buffer
+        # this server holds to a named component. Keys are namespaced by
+        # owner so fleet replicas report their own share; the weight
+        # tree is keyed by the TREE's identity — N replicas built off
+        # one tree register the same entry once (a resize to the same
+        # size is a no-op).
+        self._mem_owner = f"b{id(self):x}"
+        if self._prefix_cache is not None:
+            # Re-key the cache's ledger entry under this server's owner
+            # namespace so the per-replica view (GET /fleet) includes
+            # its prefix bytes (safe pre-insert: no entry exists yet).
+            self._prefix_cache._mem_key = \
+                f"{self._mem_owner}/prefix_cache"
+        obs_memory.LEDGER.register(
+            "weights", f"shared/params-{id(params):x}",
+            obs_memory.params_bytes(params))
+        obs_memory.LEDGER.register(
+            "kv_cache", f"{self._mem_owner}/kv_cache",
+            obs_memory.params_bytes(self.cache))
+        obs_memory.LEDGER.register(
+            "logits", f"{self._mem_owner}/logits", self.logits.nbytes)
+        if self.speculative:
+            obs_memory.LEDGER.register(
+                "ids_buf", f"{self._mem_owner}/ids_buf",
+                self.ids_buf.nbytes)
+            obs_memory.LEDGER.register(
+                "draft", f"{self._mem_owner}/spec_drafts",
+                self.spec_drafts.nbytes)
+        if draft_head is not None:
+            obs_memory.LEDGER.register(
+                "draft", f"shared/medusa-{id(draft_head):x}",
+                obs_memory.params_bytes(draft_head))
+        if self.pipeline:
+            # Device-resident scheduler carry (frozen bool + n_rem i32
+            # + base_pos i32): small, but it IS a named resident
+            # allocation — the taxonomy stays exhaustive.
+            self._mem_carry_bytes = max_batch * (
+                1 + 4 + (4 if self.speculative else 0))
+            obs_memory.LEDGER.register(
+                "carry", f"{self._mem_owner}/carry", self._mem_carry_bytes)
+        # Admission headroom guard (ISSUE 9): defer admission waves when
+        # the ledger predicts the next wave would push the accounted
+        # total past capacity - headroom. 0 = off (the A/B escape
+        # hatch and the library default). Capacity: explicit override,
+        # else the device's reported limit (0 on CPU -> guard inert).
+        self.mem_headroom_bytes = max(int(mem_headroom_bytes), 0)
+        self._mem_capacity = int(mem_capacity_bytes) or (
+            obs_memory.device_capacity_bytes()
+            if self.mem_headroom_bytes else 0)
+        self.mem_deferrals = 0
+        # Compiled-footprint probe result (warmup() fills it; lazily
+        # probed on first memory_stats() otherwise).
+        self._compiled_footprint: Optional[Dict[str, Any]] = None
         self.reset_serving_stats()
+
+    def __del__(self):
+        # A dropped batcher must not leave stale owner-keyed bytes in
+        # the memory ledger (multi-server processes: fleet rebuilds,
+        # bench legs, tests). The shared weight-tree entry stays — the
+        # tree may outlive this server. Best-effort: interpreter
+        # teardown may have torn the ledger down first.
+        owner = getattr(self, "_mem_owner", None)
+        if owner is None:
+            return  # __init__ raised before registration
+        try:
+            for comp, key in (("kv_cache", "kv_cache"),
+                              ("logits", "logits"),
+                              ("ids_buf", "ids_buf"),
+                              ("draft", "spec_drafts"),
+                              ("carry", "carry"),
+                              ("lanes", "lanes")):
+                obs_memory.LEDGER.release(comp, f"{owner}/{key}")
+        except Exception:
+            pass
 
     def _init_mesh_placement(self, vocab: int) -> None:
         """Place the resident buffers on the serving mesh and record their
@@ -1667,6 +1768,11 @@ class ContinuousBatcher:
                                       record=False) is not None:
                     warmed_shapes.add(shape_key)
                     n += 1
+        # Compiled-footprint probe (ISSUE 9): the segment executable was
+        # compiled moments ago, so the AOT re-lower here is a compile-
+        # cache load — record its temp/argument/output sizes while the
+        # server is still idle (compiled_stats never raises).
+        self._compiled_footprint = self._probe_compiled_footprint()
         return n
 
     def set_prefix(self, input_ids: Sequence[int],
@@ -2047,6 +2153,7 @@ class ContinuousBatcher:
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(rid, ids, pixel_values, max_new_tokens)
+        req.prompt_len = prompt_len
         req.slo = slo
         req.t_submit = time.perf_counter()
         if deadline_s is not None:
@@ -2179,6 +2286,124 @@ class ContinuousBatcher:
             return {"enabled": False}
         return {"enabled": True, "insert_on_prefill": self.prefix_insert,
                 **self._prefix_cache.stats()}
+
+    def memory_summary(self) -> Dict[str, Any]:
+        """Cheap ledger view (host ints only — safe once per scheduler
+        step): process totals + this server's own component share + the
+        headroom-guard state. ``/stats`` merges it under ``"memory"``
+        the way ``"slo"`` rides the snapshot."""
+        s = obs_memory.LEDGER.summary()
+        s["owner"] = obs_memory.LEDGER.snapshot(self._mem_owner)
+        s["guard"] = {
+            "headroom_bytes": self.mem_headroom_bytes,
+            "capacity_bytes": self._mem_capacity,
+            "deferrals": self.mem_deferrals,
+        }
+        return s
+
+    def memory_estimate(self) -> Dict[str, Any]:
+        """The static capacity model at THIS server's exact config
+        (``obs.memory.estimate``): what the resident components should
+        cost, from closed-form arithmetic — the number the ledger is
+        reconciled against and the planning tool for configs that do
+        not exist yet."""
+        return obs_memory.estimate(
+            self.cfg, max_batch=self.max_batch, max_len=self.max_len,
+            kv_quant=self.kv_quant,
+            dtype_bytes=jnp.dtype(self._dtype).itemsize,
+            speculative=self.speculative,
+            prefill_budget=self.prefill_budget,
+            prefill_lane_chunk=self._lane_chunk,
+            lane_bucket=self._lane_bucket or None,
+            prefix_cache_bytes=(self._prefix_cache.budget
+                                if self._prefix_cache is not None else 0),
+            weights_bytes=obs_memory.params_bytes(self.params),
+            vocab=int(self.logits.shape[1]),
+            mesh_shape=(dict(self.mesh.shape)
+                        if self.mesh is not None else None),
+        )
+
+    def memory_stats(self, reconcile: bool = True) -> Dict[str, Any]:
+        """The ``GET /memory`` payload: ledger summary + a FRESH
+        ``jax.live_arrays()`` reconciliation + the static estimate + the
+        compiled-footprint probe. Walks every live buffer — poll-route
+        cost, never per-step (``memory_summary`` is the cheap form)."""
+        out = self.memory_summary()
+        if reconcile:
+            out["reconcile"] = obs_memory.LEDGER.reconcile()
+        out["estimate"] = self.memory_estimate()
+        out["compiled"] = self.compiled_footprint()
+        return out
+
+    def compiled_footprint(self, probe: bool = True) -> Dict[str, Any]:
+        """XLA-side bytes of the segment executable this server
+        dispatches (temp/argument/output sizes via
+        ``memory_analysis()``) — the allocations the ledger cannot see.
+        ``warmup()`` fills it right after compiling the executables (the
+        AOT re-lower is a compile-cache load there); otherwise probed
+        lazily on first call. ``probe=False`` only reports what exists."""
+        if self._compiled_footprint is None and probe:
+            self._compiled_footprint = self._probe_compiled_footprint()
+        return self._compiled_footprint or {"probed": False}
+
+    def _probe_compiled_footprint(self) -> Dict[str, Any]:
+        """Lower + compile the resident decode/spec segment at the live
+        shapes and pull ``memory_analysis()`` (``obs.memory.
+        compiled_stats``). AOT lowering never executes, so the donated
+        resident buffers are safe to pass."""
+        frozen = jnp.asarray(np.ones((self.max_batch,), bool))
+        n_rem = jnp.zeros((self.max_batch,), jnp.int32)
+        base_pos = (jnp.zeros((self.max_batch,), jnp.int32)
+                    if self.speculative else None)
+        if self.mesh is not None:
+            frozen, n_rem, base_pos = self._serving.place_carry(
+                self.mesh, self.max_batch, frozen, n_rem, base_pos)
+        if self.speculative:
+            n_iters = max(1, self.chunk // self.speculative)
+            history = (jnp.asarray(self._history.astype(np.int32))
+                       if self._history is not None else None)
+            if self.mesh is not None:
+                if history is not None:
+                    history = self._serving.replicate(history, self.mesh)
+                fn = _get_sharded_spec_segment(
+                    self.cfg, n_iters, self.speculative, int(self.eos),
+                    self.temperature, self.top_p, self._cache_flat_sh,
+                    self._cache_treedef, self._ids_sh, self._b_sh,
+                    self._key_sh, self._drafts_sh,
+                )
+                stats = obs_memory.compiled_stats(
+                    fn, self.params, self.cache, self.key, self.ids_buf,
+                    base_pos, frozen, n_rem, history, self.draft_head,
+                    self.spec_drafts,
+                )
+            else:
+                stats = obs_memory.compiled_stats(
+                    _spec_segment_jit, self.params, self.cfg, self.cache,
+                    self.key, self.ids_buf, base_pos, frozen, n_rem,
+                    n_iters, self.speculative, int(self.eos),
+                    self.temperature, self.top_p, history=history,
+                    medusa=self.draft_head, drafts=self.spec_drafts,
+                )
+        elif self.mesh is not None:
+            fn = _get_sharded_decode_segment(
+                self.cfg, self.chunk, int(self.eos), self.temperature,
+                self.top_p, self.nan_check, self._cache_flat_sh,
+                self._cache_treedef, self._logits_sh, self._toks_sh,
+                self._b_sh, self._key_sh,
+            )
+            stats = obs_memory.compiled_stats(
+                fn, self.params, self.logits, self.cache, self.key,
+                frozen, n_rem,
+            )
+        else:
+            stats = obs_memory.compiled_stats(
+                _decode_segment_jit, self.params, self.cfg, self.logits,
+                self.cache, self.key, frozen, n_rem, self.chunk,
+                int(self.eos), self.temperature, self.top_p,
+                self.nan_check,
+            )
+        return {"segment": "spec" if self.speculative else "decode",
+                "chunk": self.chunk, **stats}
 
     def slo_stats(self) -> Dict[str, Any]:
         """SLO-attainment snapshot (ISSUE 6): per-class finished/met
@@ -2955,6 +3180,13 @@ class ContinuousBatcher:
             flat, treedef = jax.tree_util.tree_flatten(lane_sh)
             self._lane_flat_sh, self._lane_treedef = tuple(flat), treedef
             self._lane_emb_sh = self._lane_embeds.sharding
+        # Ledger resize (ISSUE 9): lane growth is the one resident
+        # allocation that moves mid-service — account it where it
+        # happens (metadata reads only; no host sync on this path).
+        obs_memory.LEDGER.resize(
+            "lanes", f"{self._mem_owner}/lanes",
+            obs_memory.params_bytes(self._lane_cache)
+            + self._lane_embeds.nbytes)
 
     def _start_full_lane(self, req: "_Request", row: int) -> None:
         """Open a piggyback lane for a full-prefill admission: the whole
@@ -3130,9 +3362,14 @@ class ContinuousBatcher:
         # fastest path to completion.
         piggy = (self.prefill_budget > 0
                  and (bool(self._lanes) or not bool(self.frozen.all())))
+        # Memory headroom guard (ISSUE 9): when the ledger predicts the
+        # next admission wave would exceed capacity - headroom, the
+        # queue stays queued this boundary — decode keeps flowing, and
+        # finishing rows free the bytes the deferred wave needs.
+        mem_defer = self._mem_guard_defers()
         wave: List[tuple] = []  # (req, row) full-prefill admissions
         hits: List[tuple] = []  # (req, row, entry, suffix_ids, fit)
-        while (self._pending is None and self.queue
+        while (self._pending is None and self.queue and not mem_defer
                and any(self.rows[r] is None
                        for r in range(self.max_batch))):
             if piggy and not self._lane_free:
@@ -3247,6 +3484,57 @@ class ContinuousBatcher:
         self._finish_admission(req, row, prompt_len, row_cache,
                                row_logits, row_hidden)
         return did_work
+
+    def _mem_next_wave_bytes(self) -> int:
+        """Predicted device bytes of admitting the queue head(s) that
+        COULD land this boundary (one per free row): the grain-rounded
+        row-cache block per member, doubled when insert-on-prefill will
+        also copy a prefix entry — conservative on purpose (a guard
+        that under-predicts is a guard that OOMs)."""
+        grain = 2 * SEQ_BUCKET
+        free = sum(1 for r in self.rows if r is None)
+        factor = 2 if (self._prefix_cache is not None
+                       and self.prefix_insert) else 1
+        total = 0
+        for i, req in enumerate(self.queue):
+            if i >= free:
+                break
+            bucket = min(((req.prompt_len + grain - 1) // grain) * grain,
+                         self.max_len)
+            total += factor * bucket * self._kv_pos_bytes
+        return total
+
+    def _mem_guard_defers(self) -> bool:
+        """One headroom-guard decision per admission boundary. Deferral
+        is pure TIMING — whatever chain a request decodes is unchanged
+        (rows are independent in attention), so armed-vs-disarmed runs
+        stay byte-identical; ``mem_headroom_bytes == 0`` (the default)
+        or an unknown capacity disarms it outright. The guard never
+        starves an idle server: with nothing in flight to free bytes,
+        deferring would deadlock, so admission proceeds regardless."""
+        if not (self.mem_headroom_bytes and self._mem_capacity
+                and self.queue):
+            return False
+        if (self._pending is None and not self._lanes
+                and all(r is None for r in self.rows)):
+            return False  # nothing in flight will ever free bytes
+        try:
+            # The guard decision is its own fault site: a trip degrades
+            # THIS boundary to guard-off (availability over protection)
+            # — admission proceeds, the trip is counted.
+            faults.maybe_fail("serve.mem_guard")
+            faults.maybe_delay("serve.mem_guard")
+        except faults.InjectedFault:
+            return False
+        predicted = self._mem_next_wave_bytes()
+        budget = self._mem_capacity - self.mem_headroom_bytes
+        if obs_memory.LEDGER.total() + predicted <= budget:
+            return False
+        self.mem_deferrals += 1
+        obs_metrics.MEM_GUARD_DEFERRALS.inc()
+        obs_trace.instant("mem_guard_defer", cat="mem",
+                          predicted_bytes=predicted)
+        return True
 
     def _prep_request(self, req: _Request):
         """Host + encode prep for one admission: CLIP encode, splice, pad

@@ -61,10 +61,14 @@ DEFAULT_ABS_FLOOR = 0.002  # seconds-scale values below this compare equal
 # direction. Checked in order; first match wins.
 _HIGHER = ("tok_s", "tokens_per_s", "goodput", "attainment", "hit_ratio",
            "met_ratio", "overlap_ratio", "mfu", "tokens_per_iteration",
-           "goodput_ratio")
+           "goodput_ratio", "accounted_ratio")
+# Memory-ledger keys (ISSUE 9) gate lower-is-better: a grown resident
+# peak or a grown unaccounted share is a regression under the same
+# ±15% scheme (component echo keys carry no direction — informational).
 _LOWER = ("ttft", "itl", "latency", "stall", "step_s", "step_time", "_ms",
           "wait", "duration_s", "first_request_s", "warmup_s", "_p50_s",
-          "_p99_s", "_p95_s", "overhead_frac")
+          "_p99_s", "_p95_s", "overhead_frac", "peak_bytes",
+          "unaccounted_bytes")
 
 
 def direction(key: str) -> Optional[int]:
@@ -164,6 +168,25 @@ def compare(base: Dict[str, Any], new: Dict[str, Any],
                 f"unpaired   tok_s ({len(dropped)} key(s)) not gated: "
                 f"workload output-cap identity differs or is "
                 f"unrecorded (base={bi}, new={ni})")
+    # Memory keys pair only within one topology (ISSUE 9): a fleet
+    # point's ledger peak covers N resident caches, a single-engine
+    # point's covers one — cross-topology "regressions" there would be
+    # architecture, not drift. Same design as the tok_s identity rule.
+    bt = _unwrap(base).get("fleet")
+    nt = _unwrap(new).get("fleet")
+    if bt != nt:
+        dropped = sorted(k for k in set(b) | set(n)
+                         if "mem_peak" in k or ".memory." in k
+                         or "memory_bytes" in k)
+        for k in dropped:
+            b.pop(k, None)
+            n.pop(k, None)
+        if dropped:
+            notes.append(
+                f"unpaired   memory ({len(dropped)} key(s)) not gated: "
+                f"replica topology differs (base fleet={bt}, new "
+                f"fleet={nt}) — ledger peaks only pair within one "
+                f"topology")
     for key in sorted(set(b) & set(n)):
         d = direction(key)
         if d is None:

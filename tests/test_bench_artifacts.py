@@ -61,6 +61,14 @@ def test_workload_artifacts_schema():
             for k in ("rate_mult", "offered_rps", "duration_s",
                       "goodput_rps", "slo_met_ratio", "tok_s", "classes"):
                 assert k in leg, (p, k)
+            # Memory ledger keys (ISSUE 9): every serve point records
+            # where the bytes live — peak, component breakdown, and the
+            # live-array reconcile.
+            assert isinstance(leg.get("mem_peak_bytes"), int) \
+                and leg["mem_peak_bytes"] > 0, (p, "mem_peak_bytes")
+            mem = leg["memory"]
+            assert mem["components"].get("kv_cache", 0) > 0, p
+            assert mem["reconcile"]["live_bytes"] > 0, p
             assert len(leg["classes"]) >= 2, \
                 f"{p}: need >= 2 SLO classes per point"
             for cname, c in leg["classes"].items():
@@ -92,15 +100,19 @@ def test_fleet_workload_artifact_schema():
             for k in ("rate_mult", "goodput_rps", "slo_met_ratio",
                       "tok_s", "prefix_cache_hit_ratio", "classes",
                       "shed_total", "rejected_total", "failovers",
-                      "replicas"):
+                      "replicas", "mem_peak_bytes"):
                 assert k in leg, (p, k)
             assert len(leg["classes"]) >= 2, \
                 f"{p}: need >= 2 SLO classes per point"
             assert len(leg["replicas"]) == rec["fleet"], p
             for rep in leg["replicas"]:
                 for k in ("replica", "requests", "goodput_rps",
-                          "slo_met_ratio", "prefix_cache_hit_ratio"):
+                          "slo_met_ratio", "prefix_cache_hit_ratio",
+                          "memory_bytes"):
                     assert k in rep, (p, k)
+                # Per-replica resident share (ISSUE 9): each replica
+                # owns its own cache — a real, nonzero byte count.
+                assert rep["memory_bytes"] > 0, (p, rep["replica"])
 
 
 def test_compare_bench_gates_fleet_vs_single_workload():
@@ -156,6 +168,30 @@ def test_compare_bench_tok_s_pairs_only_on_matching_output_caps():
     regs, notes = mod.compare(legacy, rec)
     assert not any("tok_s" in r for r in regs)
     assert any("unpaired" in n for n in notes)
+
+
+def test_compare_bench_requires_ledger_peak_on_serve_legs():
+    """ISSUE 9 satellite: the artifact gate --require's the ledger peak
+    on the serve legs — mem_peak_bytes is comparable on the checked-in
+    workload artifact (same topology), gates lower-is-better (a grown
+    resident peak fires), and cross-topology pairs drop memory keys
+    with an unpaired note instead of gating architecture as drift."""
+    mod = _compare_mod()
+    rec = _load(os.path.join(ROOT, "WORKLOAD_r01.json"))
+    regs, _ = mod.compare(rec, rec, require=("mem_peak_bytes",))
+    assert regs == [], f"mem_peak_bytes must be self-comparable: {regs}"
+    worse = json.loads(json.dumps(rec))
+    for leg in worse["sweep"]:
+        leg["mem_peak_bytes"] = int(leg["mem_peak_bytes"] * 2)
+    regs, _ = mod.compare(rec, worse, require=("mem_peak_bytes",))
+    assert any("mem_peak_bytes" in r for r in regs)
+    # Fleet vs single: the ledger peak covers N caches vs one — memory
+    # keys are dropped (the tok_s identity design) and never gated.
+    fleet = _load(sorted(glob.glob(
+        os.path.join(ROOT, "WORKLOAD_FLEET_r0*.json")))[0])
+    regs, notes = mod.compare(rec, fleet)
+    assert not any("mem_peak" in r or ".memory." in r for r in regs)
+    assert any("memory" in n and "unpaired" in n for n in notes)
 
 
 def test_compare_bench_gates_checked_in_rounds():

@@ -195,6 +195,41 @@ def test_replica_kill_chaos_drain_reroute_recovery(tiny):
         fleet.shutdown()
 
 
+def test_kill_delivers_requests_finished_by_the_drain(tiny):
+    """A kill can land with a request's LAST segment in flight: the
+    drain inside ``export_requests`` finishes it AFTER the engine's
+    pre-export harvest ran, and it has left the rows so it is never
+    exported either. ``kill()`` must harvest again or the answer
+    strands in ``batcher.finished`` with the loop parked — the fleet
+    supervisor then polls ``try_result`` forever and the fleet request
+    hangs (the intermittent replica-kill chaos timeout)."""
+    from eventgpt_tpu.cli.serve import ServingEngine
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+
+    cfg, _ = tiny
+    ref_b = _batcher(tiny)
+    rref = ref_b.submit(_ids((80,)), _pv(cfg, 400), 2)
+    ref = ref_b.run_until_drained()[rref]
+
+    eng = ServingEngine(_batcher(tiny), load_tokenizer("byte"))
+    try:
+        # Park the scheduler loop: the test drives the batcher itself
+        # so the kill lands DETERMINISTICALLY with the request's only
+        # decode segment still in flight (chunk == max_new_tokens).
+        eng._stop = True
+        eng._wake.set()
+        eng._thread.join(timeout=10)
+        rid = eng.submit_ids(_ids((80,)), _pv(cfg, 400), 2)
+        eng.batcher.step()  # admit + dispatch; nothing harvested yet
+        assert any(r is not None for r in eng.batcher.rows)
+        assert not eng.batcher.finished
+        assert eng.kill() == []  # drain finished it: nothing to export
+        assert not eng.batcher.finished  # ...and nothing stranded
+        assert eng.try_result(rid) == (ref, "ok")
+    finally:
+        eng.shutdown()
+
+
 def test_http_queue_full_429_retry_after_is_class_aware(tiny, tmp_path):
     """Satellite: the queue-full 429's Retry-After derives from the
     goodput window per class — batch is told to back off harder than

@@ -518,6 +518,59 @@ def test_slo_class_scoring_over_http(tmp_path):
         engine.shutdown()
 
 
+def test_memory_route_and_stats_merge_over_http(tmp_path):
+    """ISSUE 9: GET /memory returns the ledger payload (per-component
+    bytes, live-array reconciliation, static estimate, compiled
+    footprint) and GET /stats merges the cheap "memory" summary the way
+    "slo" rides it — one poll shows latency, goodput and bytes."""
+    import jax
+
+    from eventgpt_tpu.cli.serve import ServingEngine, make_handler
+    from eventgpt_tpu.config import EventChatConfig
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.models import eventchat
+    from eventgpt_tpu.serve import ContinuousBatcher
+    from http.server import ThreadingHTTPServer
+
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None)
+    engine = ServingEngine(srv, load_tokenizer("byte"))
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0), make_handler(engine, cfg))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        b64 = _tiny_event_b64(tmp_path)
+        _post(url, {"query": "What is happening?", "event_b64": b64,
+                    "max_new_tokens": 4})
+        with urllib.request.urlopen(url + "/memory", timeout=120) as r:
+            m = json.loads(r.read())
+        assert m["total_bytes"] > 0
+        assert m["components"]["kv_cache"] > 0
+        assert m["components"]["weights"] > 0
+        assert m["reconcile"]["live_bytes"] > 0
+        # Owner view = THIS server's share (process components may also
+        # hold sibling test servers' buffers).
+        assert m["estimate"]["components"]["kv_cache"] == \
+            m["owner"]["kv_cache"]
+        assert "compiled" in m and "guard" in m
+        with urllib.request.urlopen(url + "/stats", timeout=60) as r:
+            s = json.loads(r.read())
+        assert s["memory"]["total_bytes"] > 0
+        assert s["memory"]["guard"]["deferrals"] == 0
+        # The egpt_mem_* gauges reach the Prometheus exposition too.
+        with urllib.request.urlopen(url + "/metrics", timeout=60) as r:
+            text = r.read().decode()
+        assert "egpt_mem_total_bytes" in text
+        assert 'egpt_mem_component_bytes{component="kv_cache"}' in text
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        engine.shutdown()
+
+
 def test_prefix_route_reuses_kv_and_keeps_chains(tmp_path):
     """VERDICT residue: shared-prefix KV reuse through the PRODUCT HTTP
     server. POST /prefix installs the conversation head's KV once; the
