@@ -258,11 +258,53 @@ def _literal_label_values(node: ast.AST) -> List[str]:
     return []
 
 
+def _journey_enums(sources) -> Dict[str, Tuple[tuple, str, int]]:
+    """``EVENT_KINDS`` / ``MISS_CAUSES`` from obs/journey.py — pure
+    literals by contract (ISSUE 10), read statically like
+    METRIC_LABELS. Returns name -> (tuple, rel, line)."""
+    out: Dict[str, Tuple[tuple, str, int]] = {}
+    for s in sources:
+        if not s.rel.endswith("obs/journey.py") or s.tree is None:
+            continue
+        for node in ast.walk(s.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Name)
+                        and tgt.id in ("EVENT_KINDS", "MISS_CAUSES")):
+                    try:
+                        out[tgt.id] = (tuple(ast.literal_eval(node.value)),
+                                       s.rel, node.lineno)
+                    except ValueError:
+                        pass
+    return out
+
+
+def _journey_aliases(tree) -> set:
+    """Names the journey module is bound to in one source file
+    (``from eventgpt_tpu.obs import journey as obs_journey`` et al) —
+    how the kind cross-check resolves ``<alias>.event(...)`` sites."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module == "eventgpt_tpu.obs":
+            for a in node.names:
+                if a.name == "journey":
+                    out.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "eventgpt_tpu.obs.journey" and a.asname:
+                    out.add(a.asname)
+    return out
+
+
 class LabelEnumRule(Rule):
     id = "tele-label"
     doc = ("labelled metric observations draw values from the fixed "
            "METRIC_LABELS enums (bounded cardinality); wired fault "
-           "sites must be members of the fault-trip site enum")
+           "sites must be members of the fault-trip site enum; journey "
+           "event kinds / miss causes stay inside the obs/journey.py "
+           "closed enums")
 
     def run(self, ctx: Context) -> List[Finding]:
         out: List[Finding] = []
@@ -343,6 +385,52 @@ class LabelEnumRule(Rule):
                         f"egpt_fault_trips_total's site enum "
                         f"(obs/metrics.py METRIC_LABELS) — its first "
                         f"trip would raise at observe time"))
+        # Flight-recorder enum cross-checks (ISSUE 10 satellite): the
+        # miss-cause metric's label enum must BE obs/journey.py's
+        # MISS_CAUSES literal, and every ``<journey alias>.event(...)``
+        # call site with a literal kind must draw it from EVENT_KINDS
+        # (the runtime raises on unknown kinds; this catches them
+        # before they ship).
+        jenums = _journey_enums(ctx.sources)
+        if "MISS_CAUSES" in jenums:
+            causes, rel, line = jenums["MISS_CAUSES"]
+            declared = enums.get(
+                "egpt_serve_slo_miss_cause_total", {}).get("cause")
+            if declared is not None and tuple(declared) != causes:
+                out.append(Finding(
+                    self.id, rel, line,
+                    f"obs/journey.py MISS_CAUSES {causes} diverged "
+                    f"from egpt_serve_slo_miss_cause_total's cause "
+                    f"enum {tuple(declared)} (obs/metrics.py "
+                    f"METRIC_LABELS) — keep the two literals "
+                    f"identical"))
+        if "EVENT_KINDS" in jenums:
+            kinds = jenums["EVENT_KINDS"][0]
+            for s in ctx.sources:
+                if s.tree is None or not s.rel.startswith("eventgpt_tpu/"):
+                    continue
+                aliases = _journey_aliases(s.tree)
+                if not aliases:
+                    continue
+                for node in ast.walk(s.tree):
+                    if not (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "event"
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id in aliases):
+                        continue
+                    kind_node = (node.args[2] if len(node.args) >= 3
+                                 else next((kw.value for kw in node.keywords
+                                            if kw.arg == "kind"), None))
+                    for lit in _literal_label_values(kind_node) \
+                            if kind_node is not None else []:
+                        if lit not in kinds:
+                            out.append(Finding(
+                                self.id, s.rel, node.lineno,
+                                f"journey event kind {lit!r} outside "
+                                f"the closed EVENT_KINDS enum "
+                                f"(obs/journey.py) — recording it "
+                                f"would raise at runtime"))
         return out
 
 

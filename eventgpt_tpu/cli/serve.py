@@ -57,10 +57,11 @@ import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from eventgpt_tpu import faults  # stdlib-only; safe before jax loads
-from eventgpt_tpu.obs import metrics as obs_metrics  # stdlib-only too
+from eventgpt_tpu.obs import journey as obs_journey  # stdlib-only too
+from eventgpt_tpu.obs import metrics as obs_metrics
 from eventgpt_tpu.obs import trace as obs_trace
 
 
@@ -412,6 +413,18 @@ class ServingEngine:
             },
         }
 
+    def journey(self, rid: int) -> Optional[Dict[str, Any]]:
+        """One request's flight-recorder timeline + decomposition
+        (ISSUE 10, ``GET /request?rid=N``). Lock-free: the recorder
+        guards its own host-side state, like the metrics registry."""
+        # egpt-check: ignore[lock] -- the batcher binding is set once in __init__ and never rebound; the journey surface reads the recorder's own lock-guarded host state only (the /memory rule)
+        return self.batcher.journey(rid)
+
+    def journeys(self, n: int = 64) -> List[Dict[str, Any]]:
+        """Recent finished request timelines (``GET /requests``)."""
+        # egpt-check: ignore[lock] -- same read-only recorder surface as journey()
+        return self.batcher.journey_index(n)
+
     def memory_stats(self) -> Dict[str, Any]:
         """The ``GET /memory`` payload (ISSUE 9): ledger + fresh
         live-array reconciliation + static estimate + compiled
@@ -555,6 +568,21 @@ class ServingEngine:
                 b._lanes.clear()
                 b._lane_free = list(range(b._lane_cap))
             failed = []
+            j_owner = getattr(b, "_journey_owner", None)
+            t_sweep = time.perf_counter()
+
+            def _fail_journey(req):
+                # The sweep bypasses _record_finish, so it closes the
+                # flight-recorder timeline itself: the journey's finish
+                # must match the engine-side terminal status
+                # byte-for-byte (the ISSUE 10 terminal-status audit).
+                if j_owner is not None:
+                    slo = getattr(req, "slo", None)
+                    obs_journey.finish(
+                        j_owner, req.rid, "engine_fault",
+                        t_submit=req.t_submit, t_done=t_sweep,
+                        slo_class=(slo.name if slo is not None else None))
+
             for r, req in enumerate(b.rows):
                 if req is None:
                     continue
@@ -569,9 +597,12 @@ class ServingEngine:
                     ent.pins -= 1
                     req.prefix_entry = None
                 failed.append(req.rid)
+                _fail_journey(req)
             b._pending = None
             if tripped:
-                failed.extend(req.rid for req in b.queue)
+                for req in b.queue:
+                    failed.append(req.rid)
+                    _fail_journey(req)
                 b.queue.clear()
             for rid in failed:
                 self._status[rid] = "engine_fault"
@@ -704,6 +735,66 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
             self.wfile.write(body)
 
         def do_GET(self):
+            from urllib.parse import parse_qs, urlsplit
+
+            # Routes take query strings since ISSUE 10 (/request?rid=N,
+            # /trace?rid=N); bare paths behave exactly as before.
+            split = urlsplit(self.path)
+            route, query = split.path, parse_qs(split.query)
+            if route == "/request":
+                # Flight recorder (ISSUE 10): one request's full event
+                # timeline + phase decomposition + dominant miss cause.
+                try:
+                    rid = int(query["rid"][0])
+                except (KeyError, ValueError, IndexError):
+                    self._json(400, {"error": "need ?rid=N"})
+                    return
+                rec = engine.journey(rid)
+                if rec is None:
+                    self._json(404, {
+                        "error": f"no journey for rid {rid} (unknown, "
+                                 f"evicted from the retention ring, or "
+                                 f"the recorder is disarmed — "
+                                 f"--journey_keep)"})
+                    return
+                self._json(200, rec)
+                return
+            if route == "/requests":
+                # Recent finished index: rid / status / slo / cause —
+                # the "which request should I look at" entry point of
+                # the slow-request runbook (OBSERVABILITY.md).
+                try:
+                    n = int(query.get("n", ["64"])[0])
+                except ValueError:
+                    self._json(400, {"error": "bad ?n="})
+                    return
+                self._json(200, {"requests": engine.journeys(n),
+                                 "enabled": obs_journey.enabled()})
+                return
+            if route == "/trace":
+                tracer = obs_trace.active()
+                if tracer is None:
+                    self._json(404, {"error": "tracing disarmed "
+                                              "(--trace_buffer 0)"})
+                    return
+                evs = tracer.events()
+                if "rid" in query:
+                    # ?rid=N filters the ring to one request's spans
+                    # (ISSUE 10 satellite): the async lifecycle events
+                    # carry the rid as their Chrome-trace id, and
+                    # rid-stamped args match too — the device-level
+                    # half of a flight-recorder timeline.
+                    try:
+                        rid = int(query["rid"][0])
+                    except (ValueError, IndexError):
+                        self._json(400, {"error": "bad ?rid="})
+                        return
+                    evs = [e for e in evs
+                           if e.get("id") == rid
+                           or (e.get("args") or {}).get("rid") == rid]
+                self._json(200, {"traceEvents": evs,
+                                 "droppedEvents": tracer.dropped()})
+                return
             if self.path == "/health":
                 if engine.breaker_open():
                     # Breaker open: the load balancer should drain this
@@ -748,16 +839,6 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
-            elif self.path == "/trace":
-                tracer = obs_trace.active()
-                if tracer is None:
-                    self._json(404, {"error": "tracing disarmed "
-                                              "(--trace_buffer 0)"})
-                    return
-                # Standard Chrome trace JSON object: load directly in
-                # Perfetto / chrome://tracing.
-                self._json(200, {"traceEvents": tracer.events(),
-                                 "droppedEvents": tracer.dropped()})
             else:
                 self._json(404, {"error": f"no route {self.path}"})
 
@@ -965,6 +1046,12 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                     obj["slo_class"] = slo.name
                     if "slo_met" in stats:
                         obj["slo_met"] = bool(stats["slo_met"])
+                if payload.get("debug"):
+                    # Flight recorder (ISSUE 10): {"debug": true} rides
+                    # the request's own response with its full timeline
+                    # + phase decomposition — no second round trip to
+                    # /request?rid=N needed while debugging a client.
+                    obj["debug"] = engine.journey(rid)
                 # Forced finishes map to structured HTTP errors (the
                 # partial answer rides along): deadline -> 504,
                 # cancel -> 499 (client asked), NaN quarantine -> 500.
@@ -1059,10 +1146,17 @@ def build_server(args) -> tuple:
     if getattr(args, "no_telemetry", False):
         obs_metrics.configure(False)
         obs_trace.disable()
+        obs_journey.disable()
     else:
         buf = int(getattr(args, "trace_buffer", 65536) or 0)
         if buf > 0:
             obs_trace.configure(buf)
+        # Flight recorder (ISSUE 10): last N finished request
+        # timelines, armed like the span tracer (0 disarms; disarmed =
+        # one global check per probe, chains byte-identical either way).
+        keep = int(getattr(args, "journey_keep", 512) or 0)
+        if keep > 0:
+            obs_journey.configure(keep)
     if getattr(args, "profile_dir", None):
         from eventgpt_tpu.obs import profiling as obs_profiling
 
@@ -1334,6 +1428,12 @@ def main(argv=None):
                    help="finished SLO-classed requests in the windowed "
                         "goodput gauge egpt_serve_slo_goodput_ratio")
     # -- telemetry (ISSUE 3; OBSERVABILITY.md) --
+    p.add_argument("--journey_keep", type=int, default=512,
+                   help="flight recorder: retain the last N finished "
+                        "request timelines (GET /requests, "
+                        "GET /request?rid=N, per-request debug blocks "
+                        "and the egpt_serve_slo_miss_cause_total "
+                        "attribution ride it; 0 disarms)")
     p.add_argument("--trace_buffer", type=int, default=65536,
                    help="request/step trace ring capacity in events "
                         "(GET /trace snapshots it; 0 disarms tracing)")

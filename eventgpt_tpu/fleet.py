@@ -59,6 +59,7 @@ import numpy as np
 
 from eventgpt_tpu import faults
 from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
+from eventgpt_tpu.obs import journey as obs_journey
 from eventgpt_tpu.obs import metrics as obs_metrics
 from eventgpt_tpu.obs import trace as obs_trace
 
@@ -289,6 +290,11 @@ class Fleet:
         self.n_kills = 0
         self.n_route_faults = 0
         self.fault: Any = None                 # repr of the last replica loss
+        # Flight recorder (ISSUE 10): the router records its own
+        # request-level timeline (route / shed / failover / repin) under
+        # a fleet owner id; per-replica decode timelines live under each
+        # batcher's owner, stitched together by ``journey(frid)``.
+        self._journey_owner = obs_journey.register_owner("fleet")
         obs_metrics.FLEET_REPLICAS.set(len(self.replicas))
         obs_metrics.FLEET_ROUTABLE.set(len(self.replicas))
         self._thread = threading.Thread(target=self._supervise, daemon=True)
@@ -350,7 +356,25 @@ class Fleet:
         ``FleetShedError`` (policy shed), the replica's
         ``QueueFullError`` (every routable replica full), or
         ``RuntimeError`` when no replica is routable at all."""
-        self._maybe_shed(slo)
+        try:
+            self._maybe_shed(slo)
+        except FleetShedError:
+            # A shed is a terminal outcome the flight recorder must
+            # still explain: it gets an frid-keyed timeline of its own
+            # (submit -> shed -> finish{status: shed}), so /requests
+            # shows refusals next to served traffic.
+            if obs_journey.enabled():
+                with self._lock:
+                    frid = self._next_frid
+                    self._next_frid += 1
+                t = time.perf_counter()
+                cls = getattr(slo, "name", None)
+                obs_journey.begin(self._journey_owner, frid, t=t,
+                                  slo_class=cls)
+                obs_journey.event(self._journey_owner, frid, "shed", t=t)
+                obs_journey.finish(self._journey_owner, frid, "shed",
+                                   t_submit=t, t_done=t, slo_class=cls)
+            raise
         key = affinity_key(input_ids, pixels)
         with self._lock:
             rep, reason = self._route_locked(key)
@@ -372,6 +396,13 @@ class Fleet:
             self._requests[frid] = freq
             self._pins[key] = rep.idx
             self.n_requests += 1
+            obs_journey.begin(
+                self._journey_owner, frid, t=freq.t_submit,
+                budget=max_new_tokens,
+                **({"slo_class": slo.name} if slo is not None else {}))
+            obs_journey.event(self._journey_owner, frid, "route",
+                              t=freq.t_submit, replica=rep.idx,
+                              replica_rid=rid, reason=reason)
         obs_metrics.FLEET_QUEUE_DEPTH.set(self.queue_depth())
         return frid
 
@@ -674,16 +705,102 @@ class Fleet:
             self.fault = repr(e)
             self._finish_locked(freq, None, "engine_fault")
             return
+        old_replica = freq.replica
         freq.replica = rep.idx
         self._pins[freq.key] = rep.idx
         self.n_failovers += 1
         obs_metrics.FLEET_FAILOVERS.inc()
         obs_metrics.FLEET_ROUTED.inc(reason="repin")
+        obs_journey.event(self._journey_owner, freq.frid, "failover",
+                          from_replica=old_replica, to_replica=rep.idx,
+                          replica_rid=freq.rid)
+        obs_journey.event(self._journey_owner, freq.frid, "repin",
+                          replica=rep.idx)
+
+    @staticmethod
+    def _assignments_of(events) -> List[tuple]:
+        """(replica, rid) per assignment, from a fleet journey's route/
+        failover events (works on both the raw and export shapes)."""
+        out = []
+        for ev in events:
+            if ev.get("kind") == "route":
+                out.append((ev.get("replica"), ev.get("replica_rid")))
+            elif ev.get("kind") == "failover":
+                out.append((ev.get("to_replica"), ev.get("replica_rid")))
+        return out
+
+    def _stitch_locked(self, freq: _FleetRequest):
+        """(t_submit, t_done, phases) of the whole fleet request,
+        stitched across its assignments: the FINAL assignment's phase
+        decomposition plus ``failover_redo_s`` = the wall time the
+        abandoned assignments burned (first replica submit -> final
+        replica submit — queued, decoded-and-discarded, and re-routed
+        time all land there, which is exactly what a failover costs).
+        The sum invariant holds by construction: phases partition
+        [first.t_submit, final.t_done]. None when the recorder is
+        disarmed or the replica timelines are gone."""
+        raw = obs_journey.raw(self._journey_owner, freq.frid)
+        if raw is None:
+            return None
+        raws = []
+        for rep_idx, rid in self._assignments_of(raw["events"]):
+            if rep_idx is None or rid is None \
+                    or not (0 <= rep_idx < len(self.replicas)):
+                continue
+            b = self.replicas[rep_idx].engine.batcher
+            r = obs_journey.raw(getattr(b, "_journey_owner", -1), rid)
+            if r is not None:
+                raws.append(r)
+        final = next((r for r in reversed(raws)
+                      if r.get("finished") and r.get("phases")), None)
+        if final is None:
+            return None
+        first = raws[0]
+        redo = max(final["t_submit"] - first["t_submit"], 0.0)
+        phases = dict(final["phases"])
+        phases["failover_redo_s"] = redo
+        return first["t_submit"], final["t_done"], phases
+
+    def journey(self, frid: int) -> Optional[Dict[str, Any]]:
+        """Fleet passthrough of ``GET /request?rid=N`` (ISSUE 10): the
+        router-level timeline (route / shed / failover / repin) with
+        each assignment's replica timeline attached, plus the stitched
+        decomposition stored at finish."""
+        rec = obs_journey.get(self._journey_owner, frid)
+        if rec is None:
+            return None
+        legs = []
+        for rep_idx, rid in self._assignments_of(rec["events"]):
+            jr = None
+            if rep_idx is not None and rid is not None \
+                    and 0 <= rep_idx < len(self.replicas):
+                jr = self.replicas[rep_idx].engine.batcher.journey(rid)
+            legs.append({"replica": rep_idx, "rid": rid, "journey": jr})
+        rec["assignments"] = legs
+        return rec
+
+    def journeys(self, n: int = 64) -> List[Dict[str, Any]]:
+        """Recent finished fleet requests (``GET /requests``)."""
+        return obs_journey.index(self._journey_owner, n)
 
     def _finish_locked(self, freq: _FleetRequest, tokens,
                        status: str) -> None:
         freq.tokens = tokens
         freq.status = status
+        if obs_journey.enabled():
+            # Close the fleet journey BEFORE releasing the waiter: a
+            # client that polls journey(frid) right after result()
+            # must see the finished, stitched record.
+            stitched = self._stitch_locked(freq)
+            slo_met = freq.stats.get("slo_met")
+            obs_journey.finish(
+                self._journey_owner, freq.frid, status,
+                t_submit=(stitched[0] if stitched else freq.t_submit),
+                t_done=(stitched[1] if stitched else None),
+                slo_class=getattr(freq.slo, "name", None),
+                slo_met=(bool(slo_met) if slo_met is not None else None),
+                phases=(stitched[2] if stitched else None),
+                failovers=freq.failovers)
         freq.done.set()
         # Bounded finished map (the engine's request_stats rule): a
         # long-lived router must not grow per-request state forever.

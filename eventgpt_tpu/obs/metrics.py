@@ -106,6 +106,15 @@ METRIC_LABELS = {
     "egpt_serve_slo_latency_seconds": {
         "slo_class": ("interactive", "batch"),
     },
+    "egpt_serve_slo_miss_cause_total": {
+        # The flight recorder's dominant-miss-cause enum (obs/journey.py
+        # MISS_CAUSES — keep the two literals identical; the egpt-check
+        # rule-5 cross-check asserts equality, this enum enforces at
+        # observe time).
+        "slo_class": ("interactive", "batch"),
+        "cause": ("queue", "defer", "admission", "decode", "host_gap",
+                  "failover_redo", "nan_quarantine", "shed", "other"),
+    },
 }
 
 
@@ -562,6 +571,13 @@ SERVE_SLO_GOODPUT = REGISTRY.gauge(
     "egpt_serve_slo_goodput_ratio",
     "Fraction of the last slo_window SLO-classed finishes that met "
     "their targets (windowed SLO-attainment goodput)")
+SERVE_SLO_MISS_CAUSE = REGISTRY.counter(
+    "egpt_serve_slo_miss_cause_total",
+    "SLO-missed finishes by class and the flight recorder's dominant "
+    "miss cause (the largest phase of the request's decomposition: "
+    "queue / defer / admission / decode / host_gap / failover_redo, "
+    "plus the non-time causes nan_quarantine / shed / other); counted "
+    "while the recorder is armed (--journey_keep > 0)")
 
 # -- fleet serving: replica supervisor + router (ISSUE 7,
 #    eventgpt_tpu/fleet.py) --

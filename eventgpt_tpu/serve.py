@@ -101,6 +101,7 @@ from jax import lax
 
 from eventgpt_tpu import faults
 from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.obs import journey as obs_journey
 from eventgpt_tpu.obs import memory as obs_memory
 from eventgpt_tpu.obs import metrics as obs_metrics
 from eventgpt_tpu.obs import profiling as obs_profiling
@@ -123,6 +124,14 @@ STATUS_OK = "ok"
 STATUS_DEADLINE = "deadline_exceeded"
 STATUS_CANCELLED = "cancelled"
 STATUS_NAN = "nan_quarantined"
+
+# Forced-finish statuses -> the flight-recorder event kind that marks
+# them in the request's timeline (obs/journey.py EVENT_KINDS).
+_JOURNEY_FORCED_KIND = {
+    STATUS_DEADLINE: "deadline",
+    STATUS_CANCELLED: "cancel",
+    STATUS_NAN: "nan_quarantine",
+}
 
 
 def _pixels_key(pixel_values) -> bytes:
@@ -1487,6 +1496,12 @@ class ContinuousBatcher:
         # one tree register the same entry once (a resize to the same
         # size is a no-op).
         self._mem_owner = f"b{id(self):x}"
+        # Flight recorder (ISSUE 10): request ids are per-batcher, so
+        # each batcher records its timelines under a process-unique
+        # owner id (a fleet runs N batchers in one process). Owner
+        # registration works disarmed too — arming later just starts
+        # recording.
+        self._journey_owner = obs_journey.register_owner(self._mem_owner)
         if self._prefix_cache is not None:
             # Re-key the cache's ledger entry under this server's owner
             # namespace so the per-replica view (GET /fleet) includes
@@ -2092,7 +2107,7 @@ class ContinuousBatcher:
         self._scatter_wave(
             [(m[0], m[1]) for m in members], row_cache, last,
             hidden if self.draft_head is not None else None, prompt_lens,
-            entries=[m[2] for m in members],
+            entries=[m[2] for m in members], path="suffix_wave",
         )
 
     def submit(self, input_ids: Sequence[int], pixel_values,
@@ -2163,6 +2178,10 @@ class ContinuousBatcher:
         obs_metrics.SERVE_QUEUE_DEPTH.set(len(self.queue))
         obs_trace.async_begin(
             "queued", rid, prompt_len=prompt_len, budget=max_new_tokens,
+            **({"slo_class": slo.name} if slo is not None else {}))
+        obs_journey.begin(
+            self._journey_owner, rid, t=req.t_submit,
+            prompt_len=prompt_len, budget=max_new_tokens,
             **({"slo_class": slo.name} if slo is not None else {}))
         return rid
 
@@ -2257,6 +2276,18 @@ class ContinuousBatcher:
             if req.deadline is not None:
                 self._n_deadlines -= 1
             obs_trace.async_end(req.phase, req.rid, status="exported")
+            # The request is not over, it is MOVING: close this
+            # replica's timeline as "exported" (a journey-only
+            # terminal — finish_status is never written here) so the
+            # fleet's stitched view can attribute the abandoned
+            # assignment's wall time to failover_redo_s.
+            obs_journey.event(self._journey_owner, req.rid, "exported",
+                              t=now)
+            obs_journey.finish(
+                self._journey_owner, req.rid, "exported",
+                t_submit=req.t_submit, t_done=now,
+                slo_class=(req.slo.name if req.slo is not None
+                           else None))
             out.append({
                 "rid": req.rid,
                 "input_ids": list(req.input_ids),
@@ -2286,6 +2317,18 @@ class ContinuousBatcher:
             return {"enabled": False}
         return {"enabled": True, "insert_on_prefill": self.prefix_insert,
                 **self._prefix_cache.stats()}
+
+    def journey(self, rid: int) -> Optional[Dict[str, Any]]:
+        """One request's flight-recorder timeline (ISSUE 10): the full
+        event list plus, once finished, the phase decomposition and
+        dominant cause (``GET /request?rid=N``). None when the recorder
+        is disarmed or the rid has left the retention ring."""
+        return obs_journey.get(self._journey_owner, rid)
+
+    def journey_index(self, n: int = 64) -> List[Dict[str, Any]]:
+        """Recent finished request timelines, newest first — the
+        ``GET /requests`` index (rid / status / slo / cause / e2e)."""
+        return obs_journey.index(self._journey_owner, n)
 
     def memory_summary(self) -> Dict[str, Any]:
         """Cheap ledger view (host ints only — safe once per scheduler
@@ -2986,6 +3029,8 @@ class ContinuousBatcher:
             else:
                 new = tokens[r, : n_new[r]]
             if len(new):
+                obs_journey.event(self._journey_owner, req.rid,
+                                  "segment", t=now, tokens=len(new))
                 if req.t_first is None:
                     req.t_first = now
                 elif req.t_last is not None:
@@ -3107,6 +3152,22 @@ class ContinuousBatcher:
             req.phase, req.rid, status=status, tokens=len(ids),
             **({"slo_class": req.slo.name, "slo_met": slo_met}
                if req.slo is not None else {}))
+        # Flight recorder (ISSUE 10): mark forced finishes, close the
+        # timeline (computes the phase decomposition + dominant cause)
+        # and export the miss cause for SLO-missed finishes. Host
+        # clocks/ints only — chains are byte-identical armed or not.
+        forced_kind = _JOURNEY_FORCED_KIND.get(status)
+        if forced_kind is not None:
+            obs_journey.event(self._journey_owner, req.rid, forced_kind,
+                              t=req.t_done)
+        jrec = obs_journey.finish(
+            self._journey_owner, req.rid, status,
+            t_submit=req.t_submit, t_done=req.t_done,
+            slo_class=(req.slo.name if req.slo is not None else None),
+            slo_met=slo_met)
+        if jrec is not None and req.slo is not None and not slo_met:
+            obs_metrics.SERVE_SLO_MISS_CAUSE.inc(
+                slo_class=req.slo.name, cause=jrec["cause"])
         if status == STATUS_OK:
             self._history_append(ids)
         self.finished[req.rid] = ids
@@ -3202,6 +3263,8 @@ class ContinuousBatcher:
             self._lane_embeds = jax.device_put(
                 self._lane_embeds, self._lane_emb_sh)
         self._lanes.append(_PendingLane(req, row, slot, prompt_len))
+        obs_journey.event(self._journey_owner, req.rid, "lane_join",
+                          slot=slot, filled=0, prompt_len=prompt_len)
 
     def _start_suffix_lane(self, req: "_Request", row: int,
                            entry: _PrefixEntry, suffix_ids,
@@ -3242,6 +3305,8 @@ class ContinuousBatcher:
                                            "lane": slot})
         self._lanes.append(_PendingLane(
             req, row, slot, prompt_len, filled=plen, entry=entry))
+        obs_journey.event(self._journey_owner, req.rid, "lane_join",
+                          slot=slot, filled=plen, prompt_len=prompt_len)
 
     def _lane_args(self) -> tuple:
         """Per-boundary lane inputs for the mixed dispatch: (start,
@@ -3320,10 +3385,13 @@ class ContinuousBatcher:
                 )
             row_cache = {"k": k, "v": v,
                          "length": jnp.asarray([l.prompt_len], jnp.int32)}
+            obs_journey.event(self._journey_owner, l.req.rid,
+                              "lane_finish", slot=l.slot,
+                              prompt_len=l.prompt_len)
             self._finish_admission(
                 l.req, l.row, l.prompt_len, row_cache, l.last_logits,
                 l.last_hidden if self.draft_head is not None else None,
-                prefix_entry=l.entry,
+                prefix_entry=l.entry, path="lane",
             )
         return done
 
@@ -3376,9 +3444,11 @@ class ContinuousBatcher:
                 break  # lanes at the token budget: the rest stay queued
             req = self.queue.popleft()
             did_work = True
+            t_deq = time.perf_counter()
             obs_metrics.SERVE_QUEUE_DEPTH.set(len(self.queue))
-            obs_metrics.SERVE_QUEUE_WAIT.observe(
-                time.perf_counter() - req.t_submit)
+            obs_metrics.SERVE_QUEUE_WAIT.observe(t_deq - req.t_submit)
+            obs_journey.event(self._journey_owner, req.rid, "queue",
+                              t=t_deq, depth=len(self.queue))
             if req.phase == "queued":
                 obs_trace.async_end("queued", req.rid)
                 obs_trace.async_begin("active", req.rid)
@@ -3403,6 +3473,9 @@ class ContinuousBatcher:
                 entry, suffix_ids = hit
                 fit = self._prefix_fit(entry, suffix_ids)
                 if fit is not None:
+                    obs_journey.event(
+                        self._journey_owner, req.rid, "prefix", hit=True,
+                        matched=entry.length, entry_tokens=len(entry.ids))
                     if piggy:
                         self._start_suffix_lane(req, row, entry,
                                                 suffix_ids, fit)
@@ -3411,6 +3484,8 @@ class ContinuousBatcher:
                     continue
             if self._prefix_cache is not None:
                 self._prefix_cache.count_miss()
+                obs_journey.event(self._journey_owner, req.rid, "prefix",
+                                  hit=False)
             if piggy:
                 self._start_full_lane(req, row)
                 continue
@@ -3448,7 +3523,7 @@ class ContinuousBatcher:
                 self._finish_admission(
                     req, row, prompt_len, row_cache, row_logits,
                     row_hidden if self.draft_head is not None else None,
-                    prefix_entry=entry,
+                    prefix_entry=entry, path="suffix",
                 )
             else:
                 self._admit_suffix_wave(members)
@@ -3534,6 +3609,18 @@ class ContinuousBatcher:
         obs_metrics.MEM_GUARD_DEFERRALS.inc()
         obs_trace.instant("mem_guard_defer", cat="mem",
                           predicted_bytes=predicted)
+        if obs_journey.enabled():
+            # Flight recorder (ISSUE 10): the deferral lands in the
+            # timeline of every queue head that COULD have admitted
+            # this boundary (the same heads _mem_next_wave_bytes
+            # predicted) — their decomposition's defer_s starts here.
+            free = sum(1 for r in self.rows if r is None)
+            for i, q in enumerate(self.queue):
+                if i >= free:
+                    break
+                obs_journey.event(self._journey_owner, q.rid,
+                                  "mem_guard_defer",
+                                  predicted_bytes=predicted)
         return True
 
     def _prep_request(self, req: _Request):
@@ -3621,6 +3708,7 @@ class ContinuousBatcher:
             self._finish_admission(
                 p.req, p.row, p.prompt_len, p.row_cache, last,
                 last_hidden if self.draft_head is not None else None,
+                path="chunk",
             )
             self._pending = None
 
@@ -3696,7 +3784,8 @@ class ContinuousBatcher:
     # egpt-check: harvest -- admission NaN quarantine is a mandated readback of the wave logits before they touch the shared cache
     def _scatter_wave(self, members: List[tuple], wave_cache, wave_logits,
                       wave_hidden, prompt_lens: List[int],
-                      entries: Optional[List[_PrefixEntry]] = None) -> None:
+                      entries: Optional[List[_PrefixEntry]] = None,
+                      path: str = "wave") -> None:
         """Common tail of both admission waves: per-member NaN
         quarantine, insert-on-prefill of new heads, the one-dispatch
         scatter of every surviving row into the shared cache, then row
@@ -3739,6 +3828,8 @@ class ContinuousBatcher:
         for i, req, row in good:
             row_hidden = (wave_hidden[i:i + 1]
                           if wave_hidden is not None else None)
+            obs_journey.event(self._journey_owner, req.rid, "admit",
+                              path=path, row=row)
             self._activate_row(req, row, prompt_lens[i],
                                wave_logits[i:i + 1], row_hidden,
                                entries[i] if entries is not None else None)
@@ -3803,7 +3894,7 @@ class ContinuousBatcher:
     # egpt-check: harvest -- admission NaN quarantine reads back the row logits before the row joins the shared cache
     def _finish_admission(self, req, row, prompt_len, row_cache,
                           row_logits, row_hidden=None,
-                          prefix_entry=None) -> None:
+                          prefix_entry=None, path: str = "full") -> None:
         """Insert the prefilled row into the shared cache + activate it."""
         if self.nan_check and not bool(
                 np.isfinite(np.asarray(jax.device_get(row_logits))).all()):
@@ -3825,6 +3916,8 @@ class ContinuousBatcher:
         self.cache, self.logits = admit(
             self.cache, self.logits, row, row_cache, row_logits
         )
+        obs_journey.event(self._journey_owner, req.rid, "admit",
+                          path=path, row=row)
         self._activate_row(req, row, prompt_len, row_logits, row_hidden,
                            prefix_entry)
 

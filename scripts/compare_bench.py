@@ -65,6 +65,16 @@ _HIGHER = ("tok_s", "tokens_per_s", "goodput", "attainment", "hit_ratio",
 # Memory-ledger keys (ISSUE 9) gate lower-is-better: a grown resident
 # peak or a grown unaccounted share is a regression under the same
 # ±15% scheme (component echo keys carry no direction — informational).
+# Flight-recorder keys (ISSUE 10): the per-class phase decomposition
+# p99s (classes.<c>.queue_p99_s / defer / admission / decode /
+# host_gap / failover_redo) ride the "_p99_s" pattern below, so a
+# grown tail phase gates lower-is-better and sweep points pair by
+# rate_mult like every other per-class percentile. The attribution
+# SHARES (classes.<c>.attribution.*) and the miss-cause COUNTS
+# (miss_causes.*) are deliberately direction-less — a shifted share is
+# a different explanation, not a regression — but they are numeric
+# leaves, so ``--require miss_causes`` fails loudly when a workload
+# record stops carrying the breakdown.
 _LOWER = ("ttft", "itl", "latency", "stall", "step_s", "step_time", "_ms",
           "wait", "duration_s", "first_request_s", "warmup_s", "_p50_s",
           "_p99_s", "_p95_s", "overhead_frac", "peak_bytes",

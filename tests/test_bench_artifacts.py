@@ -76,6 +76,23 @@ def test_workload_artifacts_schema():
                           "ttft_p99_s", "itl_p50_s", "itl_p99_s",
                           "latency_p50_s", "latency_p99_s"):
                     assert k in c, (p, cname, k)
+                # Flight-recorder attribution (ISSUE 10): per-class
+                # phase p99s + tail-latency shares on every point.
+                for ph in ("queue", "defer", "admission", "decode",
+                           "host_gap", "failover_redo"):
+                    assert f"{ph}_p99_s" in c, (p, cname, ph)
+                    assert f"{ph}_s" in c["attribution"], (p, cname, ph)
+            # Every SLO-missed request carries a dominant miss cause
+            # (the ISSUE 10 acceptance bar): the zero-filled breakdown
+            # sums to exactly the missed-request count.
+            missed = sum(c["requests"] - c["met"]
+                         for c in leg["classes"].values())
+            assert sum(leg["miss_causes"].values()) == missed, \
+                (p, leg["rate_mult"], leg["miss_causes"], missed)
+            assert isinstance(leg["slowest"], list), p
+            for ex in leg["slowest"]:
+                assert {"rid", "e2e_s", "cause", "phases",
+                        "events"} <= set(ex), (p, ex)
         ab = rec["ab"]
         assert ab["chains_identical"] is True, \
             f"{p}: SLO-armed replay diverged from plain submit"
@@ -100,8 +117,14 @@ def test_fleet_workload_artifact_schema():
             for k in ("rate_mult", "goodput_rps", "slo_met_ratio",
                       "tok_s", "prefix_cache_hit_ratio", "classes",
                       "shed_total", "rejected_total", "failovers",
-                      "replicas", "mem_peak_bytes"):
+                      "replicas", "mem_peak_bytes", "miss_causes",
+                      "slowest"):
                 assert k in leg, (p, k)
+            # The fleet legs carry the same attribution keys, stitched
+            # through the router (failover_redo_s is a real phase here).
+            for cname, c in leg["classes"].items():
+                assert "failover_redo_p99_s" in c, (p, cname)
+                assert "attribution" in c, (p, cname)
             assert len(leg["classes"]) >= 2, \
                 f"{p}: need >= 2 SLO classes per point"
             assert len(leg["replicas"]) == rec["fleet"], p
@@ -128,7 +151,7 @@ def test_compare_bench_gates_fleet_vs_single_workload():
     new = _load(sorted(glob.glob(
         os.path.join(ROOT, "WORKLOAD_FLEET_r0*.json")))[0])
     require = ("goodput_rps", "slo_met_ratio", "attainment",
-               "prefix_cache_hit_ratio", "tok_s")
+               "prefix_cache_hit_ratio", "tok_s", "miss_causes")
     regs, _ = mod.compare(base, new, require=require)
     assert regs == [], f"fleet artifact regressed the SLO-goodput " \
                        f"keys vs WORKLOAD_r01: {regs}"
@@ -192,6 +215,31 @@ def test_compare_bench_requires_ledger_peak_on_serve_legs():
     regs, notes = mod.compare(rec, fleet)
     assert not any("mem_peak" in r or ".memory." in r for r in regs)
     assert any("memory" in n and "unpaired" in n for n in notes)
+
+
+def test_compare_bench_requires_miss_cause_breakdown_on_workload_legs():
+    """ISSUE 10 satellite: the tier-1 gate --require's the miss-cause
+    breakdown on workload legs — the zero-filled counts are numeric
+    leaves in every leg, so `--require miss_causes` is self-comparable
+    on the checked-in artifact and fails loudly the day a record stops
+    carrying the breakdown. The per-phase p99 keys gate direction-aware
+    (lower is better) like every other percentile."""
+    mod = _compare_mod()
+    rec = _load(os.path.join(ROOT, "WORKLOAD_r01.json"))
+    regs, _ = mod.compare(rec, rec, require=("miss_causes",))
+    assert regs == [], f"miss_causes must be self-comparable: {regs}"
+    legacy = json.loads(json.dumps(rec))
+    for leg in legacy["sweep"]:
+        leg.pop("miss_causes")
+    regs, _ = mod.compare(legacy, rec, require=("miss_causes",))
+    assert any("not comparable" in r for r in regs)
+    # Phase p99 keys are direction-aware: a grown tail phase fires.
+    worse = json.loads(json.dumps(rec))
+    for leg in worse["sweep"]:
+        for c in leg["classes"].values():
+            c["queue_p99_s"] = max(c["queue_p99_s"] * 10, 1.0)
+    regs, _ = mod.compare(rec, worse, require=("queue_p99_s",))
+    assert any("queue_p99_s" in r for r in regs)
 
 
 def test_compare_bench_gates_checked_in_rounds():

@@ -312,6 +312,45 @@ def test_jit_rule_clean_patterns(tmp_path):
 
 # -- waiver machinery -----------------------------------------------------
 
+def test_label_rule_journey_enum_cross_checks(tmp_path):
+    """ISSUE 10 satellite: rule 5 cross-checks the flight recorder's
+    closed enums — a journey ``event()`` call site with an
+    out-of-EVENT_KINDS literal kind is a finding, and a MISS_CAUSES
+    literal that diverges from the miss-cause metric's ``cause`` enum
+    is a finding; the matching pair stays clean."""
+    from eventgpt_tpu.analysis.telemetry_rules import LabelEnumRule
+
+    def tree(cause_enum, kind):
+        pkg = tmp_path / "eventgpt_tpu"
+        pkg.mkdir(exist_ok=True)
+        obs = pkg / "obs"
+        obs.mkdir(exist_ok=True)
+        (obs / "journey.py").write_text(
+            'EVENT_KINDS = ("submit", "queue", "finish")\n'
+            'MISS_CAUSES = ("queue", "other")\n')
+        (obs / "metrics.py").write_text(
+            "METRIC_LABELS = {\n"
+            '    "egpt_serve_slo_miss_cause_total": {\n'
+            f'        "cause": {cause_enum!r},\n'
+            "    },\n"
+            "}\n")
+        (pkg / "runtime.py").write_text(
+            "from eventgpt_tpu.obs import journey as obs_journey\n"
+            "def f(owner, rid):\n"
+            f'    obs_journey.event(owner, rid, "{kind}")\n')
+        return tmp_path
+
+    msgs = [f.message for f in _run(
+        tree(("queue", "other"), "queue"), [LabelEnumRule()])
+        if not f.waived]
+    assert not any("journey" in m or "MISS_CAUSES" in m for m in msgs), msgs
+    msgs = [f.message for f in _run(
+        tree(("queue", "wrong"), "bogus_kind"), [LabelEnumRule()])
+        if not f.waived]
+    assert any("MISS_CAUSES" in m and "diverged" in m for m in msgs), msgs
+    assert any("journey event kind 'bogus_kind'" in m for m in msgs), msgs
+
+
 def test_malformed_waivers_are_findings(tmp_path):
     pkg = _pkg(tmp_path)
     (pkg / "x.py").write_text(

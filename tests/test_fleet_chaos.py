@@ -20,14 +20,20 @@ from eventgpt_tpu.config import EventChatConfig
 from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
 from eventgpt_tpu.fleet import Fleet, retry_after_s
 from eventgpt_tpu.models import eventchat
+from eventgpt_tpu.obs import journey as obs_journey
 from eventgpt_tpu.serve import ContinuousBatcher, QueueFullError
 
 
 @pytest.fixture(autouse=True)
 def _disarm():
+    # Flight recorder armed throughout (ISSUE 10): chaos runs must
+    # leave explainable timelines — the kill test asserts the
+    # failed-over requests' failover/re-decode events below.
     faults.disable()
+    obs_journey.configure(512)
     yield
     faults.disable()
+    obs_journey.disable()
 
 
 @pytest.fixture(scope="module")
@@ -181,6 +187,32 @@ def test_replica_kill_chaos_drain_reroute_recovery(tiny):
         assert out == [ref[r] for r in ref_rids]
         assert fleet.n_failovers >= 1
         assert faults.stats()["fleet.replica_kill"]["fires"] == 1
+        # Flight-recorder coverage (ISSUE 10 satellite): the killed
+        # replica's failed-over requests show the failover + re-decode
+        # in their stitched timelines — a ``failover`` event, a second
+        # assignment whose replica journey re-decoded the prompt, and
+        # failover_redo_s > 0 charging the abandoned assignment's wall
+        # time — while the chains above stayed byte-identical.
+        moved = [f for f in frids if fleet._requests[f].failovers >= 1]
+        assert moved, "no request failed over despite n_failovers >= 1"
+        deadline = time.time() + 30
+        while time.time() < deadline and any(
+                not (fleet.journey(f) or {}).get("finished")
+                for f in moved):
+            time.sleep(0.01)  # supervisor collection closes the journey
+        for f in moved:
+            j = fleet.journey(f)
+            assert j is not None and j["finished"] and j["status"] == "ok"
+            kinds = [e["kind"] for e in j["events"]]
+            assert "failover" in kinds and "repin" in kinds
+            legs = j["assignments"]
+            assert len(legs) >= 2, "failover must add an assignment"
+            final = legs[-1]["journey"]
+            assert final is not None and final["status"] == "ok"
+            assert final["segments"] >= 1  # the survivor re-decoded it
+            assert j["phases"]["failover_redo_s"] > 0.0
+            assert sum(j["phases"].values()) == pytest.approx(
+                j["e2e_s"], abs=1e-9)
         # Recovery: replica_restart_s auto-revives the dead replica and
         # re-admits it to the routing pool.
         deadline = time.time() + 30
