@@ -808,6 +808,13 @@ def run_workload(args):
     else:
         obs_journey.disable()
 
+    if int(getattr(args, "proc_fleet", 0) or 0) > 1:
+        # Process-fleet leg (ISSUE 11): the same trace through worker
+        # PROCESSES behind the RPC coordinator (params built above are
+        # unused — each worker loads its own tree, the point of the
+        # failure-domain boundary).
+        return _run_workload_procfleet(args, preset, cfg, platform,
+                                       spec, trace)
     if int(getattr(args, "fleet", 0) or 0) > 1:
         # Fleet leg (ISSUE 7): the same trace through the router tier.
         return _run_workload_fleet(args, preset, cfg, platform, params,
@@ -1314,6 +1321,255 @@ def _run_workload_fleet(args, preset, cfg, platform, params, spec, trace):
         "quant": quant_name(args, preset),
         "platform": platform,
         "telemetry": telemetry,
+    }
+    fleet.shutdown()
+    print(json.dumps(record))
+    if args.workload_out:
+        with open(args.workload_out, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    return record
+
+
+def _run_workload_procfleet(args, preset, cfg, platform, spec, trace):
+    """``--mode workload --proc_fleet N`` (ISSUE 11): replay the same
+    seeded trace through N worker PROCESSES behind the RPC
+    coordinator. The record carries the shared SLO-goodput keys
+    (goodput_rps / slo_met_ratio / per-class attainment +
+    percentiles + attribution), so compare_bench gates it against the
+    thread-fleet artifact on service quality; tok_s and memory keys
+    are per-topology by construction — N separate jax processes
+    contend for the same CPUs and keep N separate ledgers — so the
+    record sets ``proc_fleet`` and compare_bench drops those keys
+    cross-topology with an ``unpaired`` note (the PR 8/9 convention).
+    Per-worker numbers (goodput, hit ratio, OWN-process ledger bytes)
+    ride each sweep leg."""
+    import sys
+
+    import numpy as np
+
+    from eventgpt_tpu import workload as wl
+    from eventgpt_tpu.fleet_proc import ProcFleet
+    from eventgpt_tpu.serve import QueueFullError
+
+    if preset != "tiny":
+        raise SystemExit(
+            "--proc_fleet workload legs support the tiny preset only "
+            "(workers load --model_path tiny-random themselves)")
+    n_proc = int(args.proc_fleet)
+    need = max(wl.cache_positions(r, cfg.num_event_tokens)
+               + r.max_new_tokens for r in trace)
+    max_len = ((need + 1 + args.serve_spec + 127) // 128) * 128
+    worker_cmd = [
+        sys.executable, "-m", "eventgpt_tpu.cli.serve", "--worker",
+        "--model_path", "tiny-random",
+        "--max_batch", str(args.serve_batch),
+        "--max_len", str(max_len),
+        "--chunk", str(args.serve_chunk),
+        "--kv_cache", args.kv,
+        "--speculative", str(args.serve_spec),
+        "--first_chunk", str(args.serve_first_chunk or 0),
+        "--prefill_budget", str(int(args.serve_prefill_budget)),
+        "--max_queue", "0",
+    ]
+    if not args.serve_pipeline:
+        worker_cmd.append("--no_pipeline")
+    if not args.serve_prefix_cache:
+        worker_cmd.append("--no_prefix_cache")
+    if not args.serve_telemetry:
+        worker_cmd.append("--no_telemetry")
+    t0 = time.perf_counter()
+    fleet = ProcFleet(worker_cmd, n_proc, spawn_timeout_s=600,
+                      probe_interval_s=0.03, rpc_deadline_s=60.0,
+                      shutdown_drain_s=60.0)
+    t_boot = time.perf_counter() - t0
+
+    shape = (cfg.num_event_frames, 3, cfg.vision.image_size,
+             cfg.vision.image_size)
+    pix_cache = {}
+
+    def pixels_for(r):
+        if r.pixels_seed not in pix_cache:
+            pix_cache[r.pixels_seed] = wl.stream_pixels(shape, r.pixels_seed)
+        return pix_cache[r.pixels_seed]
+
+    def slo_for(r):
+        return spec.slo_for(r.slo_class)
+
+    def replay(rate_mult, paced=True, with_slo=True):
+        tr0 = time.perf_counter()
+        frids = {}
+        rejected = 0
+        for r in trace:
+            if paced:
+                while True:
+                    dt = r.t_arrival / rate_mult - (time.perf_counter()
+                                                    - tr0)
+                    if dt <= 0:
+                        break
+                    time.sleep(min(dt, 0.005))
+            try:
+                frids[r.idx] = fleet.submit_ids(
+                    r.input_ids, pixels_for(r), r.max_new_tokens,
+                    slo=slo_for(r) if with_slo else None)
+            except QueueFullError:
+                rejected += 1
+        finished = {idx: fleet.result(f, timeout=600)
+                    for idx, f in frids.items()}
+        return {"frids": frids, "finished": finished,
+                "duration_s": time.perf_counter() - tr0,
+                "rejected": rejected}
+
+    def refresh_snapshots():
+        # The supervisor refreshes snapshots once per probe tick; a
+        # point's accounting reads them RIGHT after the last finish,
+        # so fetch fresh ones explicitly.
+        for slot in fleet.slots:
+            if slot.addr is not None:
+                try:
+                    slot.snapshot = fleet._rpc(slot, "snapshot",
+                                               deadline_s=30.0)
+                except Exception:
+                    pass
+
+    if args.warmup:
+        # Cold-trajectory priming, process form: one unmeasured unpaced
+        # replay compiles the trace's wave/suffix/lane shapes inside
+        # every worker the router touches (each process has its own
+        # XLA cache).
+        replay(1.0, paced=False, with_slo=False)
+
+    class_of = {r.idx: r.slo_class for r in trace}
+    span = max(r.t_arrival for r in trace) or 1e-9
+    mults = [float(x) for x in args.workload_mults.split(",") if x]
+    sweep = []
+    for mult in mults:
+        fleet.reset_stats(
+            clear_prefix_cache=bool(args.serve_cache_insert))
+        res = replay(mult, paced=True)
+        refresh_snapshots()
+        st = fleet.slo_stats()
+        met_total = sum(c["met"] for c in st["classes"].values())
+        fin_total = sum(c["finished"] for c in st["classes"].values())
+        toks = sum(len(v) for v in res["finished"].values())
+        stats_of = fleet.batcher.request_stats
+        per_class = {}
+        for cname, cagg in sorted(st["classes"].items()):
+            stats = [stats_of.get(res["frids"][idx])
+                     for idx in res["frids"] if class_of[idx] == cname]
+            stats = [s for s in stats if s]
+
+            def pct(key, q):
+                vals = [s[key] for s in stats if key in s]
+                return round(float(np.percentile(vals, q)), 4) if vals \
+                    else 0.0
+
+            per_class[cname] = {
+                "requests": cagg["finished"],
+                "met": cagg["met"],
+                "attainment": round(cagg["attainment"], 4),
+                "ttft_p50_s": pct("ttft_s", 50),
+                "ttft_p99_s": pct("ttft_s", 99),
+                "itl_p50_s": pct("itl_s", 50),
+                "itl_p99_s": pct("itl_s", 99),
+                "latency_p50_s": pct("latency_s", 50),
+                "latency_p99_s": pct("latency_s", 99),
+            }
+        # Tail attribution from the coordinator-stitched journeys
+        # (worker-measured phases + failover_redo_s, ISSUE 10/11).
+        jmap = {idx: fleet.journey(frid)
+                for idx, frid in res["frids"].items()}
+        pc_extra, leg_extra = _journey_attribution(jmap, class_of)
+        for cname, extra in pc_extra.items():
+            per_class.setdefault(cname, {}).update(extra)
+        served_by = {}
+        for idx, frid in res["frids"].items():
+            served_by.setdefault(fleet.worker_of(frid), []).append(idx)
+        workers = []
+        for slot in fleet.slots:
+            wst = slot.snapshot.get("slo", {})
+            wmet = sum(c["met"] for c in wst.get("classes", {}).values())
+            wfin = sum(c["finished"]
+                       for c in wst.get("classes", {}).values())
+            workers.append({
+                "worker": slot.idx,
+                "state": slot.state,
+                "requests": wfin,
+                "goodput_rps": round(wmet / res["duration_s"], 3),
+                "slo_met_ratio": round(wmet / max(wfin, 1), 4),
+                "tokens": sum(len(res["finished"][i])
+                              for i in served_by.get(slot.idx, [])),
+                "prefix_cache_hit_ratio": round(
+                    slot.snapshot.get("prefix_cache", {}).get(
+                        "hit_ratio", 0.0), 3),
+                # This worker's OWN process-ledger share (its weights
+                # live in its own process — nothing is shared).
+                "memory_bytes": sum(
+                    slot.snapshot.get("memory", {}).get(
+                        "owner", {}).values()),
+            })
+        hits = sum(s.snapshot.get("prefix_cache", {}).get("hits", 0)
+                   for s in fleet.slots)
+        misses = sum(s.snapshot.get("prefix_cache", {}).get("misses", 0)
+                     for s in fleet.slots)
+        sweep.append({
+            "rate_mult": mult,
+            "offered_rps": round(len(trace) / (span / mult), 3),
+            "duration_s": round(res["duration_s"], 3),
+            "goodput_rps": round(met_total / res["duration_s"], 3),
+            "slo_met_ratio": round(met_total / max(fin_total, 1), 4),
+            "tok_s": round(toks / res["duration_s"], 2),
+            **leg_extra,
+            "prefix_cache_hit_ratio": round(
+                hits / (hits + misses), 3) if (hits + misses) else 0.0,
+            "classes": per_class,
+            # process-fleet-only keys (OBSERVABILITY.md "Process-fleet
+            # workload record"):
+            "rejected_total": res["rejected"],
+            "failovers": fleet.n_failovers,
+            "worker_deaths": fleet.n_deaths,
+            "respawns": fleet.n_respawns,
+            "workers": workers,
+            "memory": {"per_worker": [
+                {"worker": w["worker"],
+                 "memory_bytes": w["memory_bytes"]} for w in workers]},
+        })
+
+    record = {
+        "metric": f"workload_procfleet_goodput_{preset}",
+        "value": (next((l for l in sweep if l["rate_mult"] == 1.0),
+                       sweep[0])["goodput_rps"] if sweep else 0.0),
+        "unit": "req/s",
+        # Topology key: compare_bench pairs tok_s/memory only within
+        # one process topology (N jax processes contend for the same
+        # CPUs — cross-topology throughput is architecture, not drift).
+        "proc_fleet": n_proc,
+        "requests": len(trace),
+        "arrival": spec.arrival,
+        "rate_rps": spec.rate_rps,
+        "sessions": spec.sessions,
+        "seed": spec.seed,
+        "output_min": spec.output_min,
+        "output_max": spec.output_max,
+        "trace_output_tokens": sum(r.max_new_tokens for r in trace),
+        "slo": {
+            "interactive": {"ttft_s": spec.interactive_ttft_s,
+                            "itl_s": spec.interactive_itl_s},
+            "batch": {"latency_s": spec.batch_latency_s},
+        },
+        "max_batch": args.serve_batch,
+        "chunk": args.serve_chunk,
+        "prefill_budget": int(args.serve_prefill_budget),
+        "pipeline": bool(args.serve_pipeline),
+        "prefix_cache": bool(args.serve_prefix_cache),
+        "warmup": bool(args.warmup),
+        "boot_s": round(t_boot, 3),
+        "sweep": sweep,
+        "kv_cache": args.kv,
+        "speculative": args.serve_spec,
+        "quant": quant_name(args, preset),
+        "platform": platform,
+        "telemetry": bool(args.serve_telemetry),
     }
     fleet.shutdown()
     print(json.dumps(record))
@@ -2013,6 +2269,12 @@ def main() -> None:
     p.add_argument("--workload_out", default=None,
                    help="mode=workload: also write the record as a "
                         "pretty-printed WORKLOAD_r0N.json artifact")
+    p.add_argument("--proc_fleet", type=int, default=0,
+                   help="workload mode: replay through N worker "
+                        "PROCESSES behind the RPC coordinator "
+                        "(ISSUE 11; tiny preset only — workers load "
+                        "tiny-random themselves). Produces the "
+                        "workload_procfleet_* record")
     p.add_argument("--fleet", type=int, default=0,
                    help="mode=workload: replay through N ServingEngine "
                         "replicas behind the prefix-affinity router "

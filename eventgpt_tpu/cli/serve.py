@@ -181,6 +181,19 @@ class ServingEngine:
         return (self._consec_faults >= self.breaker_threshold
                 and time.monotonic() - self._t_fault < self.breaker_cooldown_s)
 
+    def breaker_retry_after_s(self) -> Optional[float]:
+        """Derived Retry-After for breaker-open 503s (ISSUE 11
+        satellite, the 429 paths' discipline): the REMAINING cooldown
+        before the half-open probe admits traffic — the one number the
+        engine actually knows about when it will take work again.
+        None while the breaker is closed (the caller falls back to the
+        goodput-derived hint)."""
+        if not self.breaker_open():
+            return None
+        remaining = (self.breaker_cooldown_s
+                     - (time.monotonic() - self._t_fault))
+        return max(remaining, 1.0)
+
     def submit(self, query: str, pixels, max_new_tokens: int,
                stream: bool = False,
                deadline_s: Optional[float] = None,
@@ -726,11 +739,13 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
         def log_message(self, fmt, *args):  # quiet by default
             pass
 
-        def _json(self, code: int, obj) -> None:
+        def _json(self, code: int, obj, headers=None) -> None:
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -798,11 +813,24 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
             if self.path == "/health":
                 if engine.breaker_open():
                     # Breaker open: the load balancer should drain this
-                    # replica until the cooldown's half-open probe.
+                    # replica until the cooldown's half-open probe. The
+                    # derived Retry-After (remaining cooldown, else the
+                    # goodput-derived hint) rides here too, so probes
+                    # and clients share one backoff story (ISSUE 11).
+                    from eventgpt_tpu.fleet import retry_after_s
+
+                    ra = getattr(engine, "breaker_retry_after_s",
+                                 lambda: None)()
+                    if ra is None:
+                        ra = retry_after_s("batch",
+                                           engine.goodput_ratio())
                     self._json(503, {"status": "degraded",
                                      "error": engine.fault,
                                      "faults": engine.n_faults,
-                                     "restarts": engine.n_restarts})
+                                     "restarts": engine.n_restarts,
+                                     "retry_after_s": round(ra, 3)},
+                               headers={"Retry-After":
+                                        str(max(1, math.ceil(ra)))})
                     return
                 s = engine.stats()
                 self._json(200, {"status": "ok",
@@ -848,7 +876,14 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                 self._json(404, {"error": f"no route {self.path}"})
                 return
             try:
-                n = int(self.headers.get("Content-Length", "0"))
+                cl = self.headers.get("Content-Length")
+                if cl is None:
+                    # Missing Content-Length (ISSUE 11 hardening): every
+                    # POST here carries a JSON body, so "no length" is
+                    # either a broken client or a smuggling probe —
+                    # reject instead of treating it as an empty body.
+                    raise ValueError
+                n = int(cl)
                 if n < 0:
                     # read(-1) would block until client EOF, pinning this
                     # handler thread forever.
@@ -1006,8 +1041,26 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
             except RuntimeError as e:
                 # Engine degraded (circuit breaker open): surface the loud
                 # 503 /health already advertises instead of letting this
-                # handler thread throw and drop the connection.
-                self._json(503, {"error": str(e)})
+                # handler thread throw and drop the connection. Like the
+                # 429 paths, the 503 carries a DERIVED Retry-After
+                # (ISSUE 11 satellite): the breaker's remaining cooldown
+                # when the engine knows it, else the class-aware
+                # goodput-derived hint.
+                cls_name = slo.name if slo is not None else "batch"
+                ra = getattr(engine, "breaker_retry_after_s",
+                             lambda: None)()
+                if ra is None:
+                    ra = retry_after_s(cls_name, engine.goodput_ratio())
+                body = json.dumps({
+                    "error": str(e),
+                    "retry_after_s": round(ra, 3),
+                }).encode()
+                self.send_response(503)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After", str(max(1, math.ceil(ra))))
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
                 return
             if stream:
                 try:
@@ -1129,12 +1182,67 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
     return Handler
 
 
-def build_server(args) -> tuple:
-    """(ThreadingHTTPServer, ServingEngine) — separated from main() so
-    tests can run the real stack in-process on an ephemeral port."""
-    from eventgpt_tpu.cli.infer import load_model, prepare_model
-    from eventgpt_tpu.parallel.serving import build_serving_mesh
-    from eventgpt_tpu.serve import ContinuousBatcher
+def _worker_argv(args) -> list:
+    """The worker process's command line: this coordinator's own model
+    + engine flags, re-serialized behind ``--worker``. Workers load the
+    model themselves (the whole point — separate processes share no
+    state), so every flag that shapes the batcher must cross here."""
+    import sys
+
+    argv = [sys.executable, "-m", "eventgpt_tpu.cli.serve", "--worker",
+            "--model_path", args.model_path,
+            "--conv_mode", args.conv_mode,
+            "--dtype", args.dtype,
+            "--quant", args.quant,
+            "--kv_cache", args.kv_cache,
+            "--max_batch", str(args.max_batch),
+            "--max_len", str(args.max_len),
+            "--chunk", str(args.chunk),
+            "--temperature", str(args.temperature),
+            "--speculative", str(args.speculative),
+            "--prefill_chunk", str(args.prefill_chunk),
+            "--prefill_budget", str(getattr(args, "prefill_budget", -1)),
+            "--first_chunk", str(getattr(args, "first_chunk", 0)),
+            "--max_queue", str(getattr(args, "max_queue", 256)),
+            "--prefix_cache_mb", str(getattr(args, "prefix_cache_mb",
+                                             512.0)),
+            "--mem_headroom_mb", str(getattr(args, "mem_headroom_mb",
+                                             0.0)),
+            "--mem_capacity_mb", str(getattr(args, "mem_capacity_mb",
+                                             0.0)),
+            "--breaker_threshold", str(getattr(args, "breaker_threshold",
+                                               3)),
+            "--breaker_cooldown_s", str(getattr(args,
+                                                "breaker_cooldown_s",
+                                                5.0)),
+            "--slo_window", str(getattr(args, "slo_window", 256)),
+            "--journey_keep", str(getattr(args, "journey_keep", 512)),
+            ]
+    if getattr(args, "tokenizer_path", None):
+        argv += ["--tokenizer_path", args.tokenizer_path]
+    if getattr(args, "draft_head", None):
+        argv += ["--draft_head", args.draft_head]
+    if getattr(args, "fuse_params", False):
+        argv += ["--fuse_params"]
+    if getattr(args, "no_pipeline", False):
+        argv += ["--no_pipeline"]
+    if getattr(args, "no_prefix_cache", False):
+        argv += ["--no_prefix_cache"]
+    if getattr(args, "no_telemetry", False):
+        argv += ["--no_telemetry"]
+    if getattr(args, "warmup", False):
+        argv += ["--warmup"]
+    return argv
+
+
+def build_engine(args, force_single: bool = False):
+    """(cfg, engine) — everything below the HTTP layer: telemetry
+    arming, model load, batcher/engine construction, and the fleet
+    tiers (``--fleet N`` threads, ``--proc_fleet N`` worker processes).
+    Shared by ``build_server`` and the process-fleet ``--worker``
+    entrypoint (``force_single`` makes a worker build exactly one
+    engine whatever the fleet flags say — a worker must never recurse
+    into spawning its own fleet)."""
     from eventgpt_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
@@ -1161,6 +1269,62 @@ def build_server(args) -> tuple:
         from eventgpt_tpu.obs import profiling as obs_profiling
 
         obs_profiling.configure(args.profile_dir)
+    if getattr(args, "faults", None):
+        # Arm fault injection from the CLI (EGPT_FAULTS works too): chaos
+        # drills against a live server use the same spec grammar as tests.
+        faults.configure(getattr(args, "faults"))
+    n_proc = int(getattr(args, "proc_fleet", 0) or 0)
+    if n_proc > 1 and not force_single:
+        # Process-fleet mode (ISSUE 11): the coordinator loads NO model
+        # — workers own their engines in their own processes (separate
+        # failure domains, the whole point). It only needs the config
+        # (pixel preprocessing in the handler) and a tokenizer (submit
+        # + routing key).
+        from eventgpt_tpu.data.tokenizer import load_tokenizer
+        from eventgpt_tpu.fleet_proc import ProcFleet
+
+        if args.model_path == "tiny-random":
+            from eventgpt_tpu.config import EventChatConfig
+
+            cfg = EventChatConfig.tiny()
+            tokenizer = load_tokenizer("byte")
+        else:
+            import json as _json
+            import os as _os
+
+            from eventgpt_tpu.models.convert import from_hf_config
+
+            with open(_os.path.join(args.model_path,
+                                    "config.json")) as f:
+                cfg = from_hf_config(_json.load(f))
+            tokenizer = load_tokenizer(
+                getattr(args, "tokenizer_path", None) or args.model_path)
+        engine = ProcFleet(
+            _worker_argv(args), n_proc,
+            tokenizer=tokenizer, conv_mode=args.conv_mode,
+            heartbeat_dir=getattr(args, "heartbeat_dir", None),
+            probe_interval_s=getattr(args, "fleet_probe_interval_s",
+                                     0.05),
+            heartbeat_stale_s=getattr(args, "fleet_heartbeat_stale_s",
+                                      5.0),
+            rpc_deadline_s=getattr(args, "procfleet_rpc_deadline_s",
+                                   15.0),
+            rpc_retries=int(getattr(args, "procfleet_rpc_retries", 3)),
+            spawn_timeout_s=getattr(args, "procfleet_spawn_timeout_s",
+                                    180.0),
+            respawn_backoff_s=getattr(args,
+                                      "procfleet_respawn_backoff_s",
+                                      0.25),
+            crash_window_s=getattr(args, "procfleet_crash_window_s",
+                                   60.0),
+            crash_limit=int(getattr(args, "procfleet_crash_limit", 3)),
+            shutdown_drain_s=getattr(args, "drain_timeout_s", 30.0),
+        )
+        return cfg, engine
+    from eventgpt_tpu.cli.infer import load_model, prepare_model
+    from eventgpt_tpu.parallel.serving import build_serving_mesh
+    from eventgpt_tpu.serve import ContinuousBatcher
+
     cfg, params, tokenizer = load_model(
         args.model_path, args.dtype, None, args.tokenizer_path
     )
@@ -1174,10 +1338,6 @@ def build_server(args) -> tuple:
         from eventgpt_tpu.models.medusa import load_medusa
 
         draft_head = load_medusa(args.draft_head)
-    if getattr(args, "faults", None):
-        # Arm fault injection from the CLI (EGPT_FAULTS works too): chaos
-        # drills against a live server use the same spec grammar as tests.
-        faults.configure(getattr(args, "faults"))
 
     def _make_batcher():
         return ContinuousBatcher(
@@ -1216,7 +1376,7 @@ def build_server(args) -> tuple:
             trace_out=getattr(args, "trace_out", None),
         )
 
-    n_fleet = int(getattr(args, "fleet", 0) or 0)
+    n_fleet = 0 if force_single else int(getattr(args, "fleet", 0) or 0)
     hb_root = getattr(args, "heartbeat_dir", None)
     if n_fleet > 1:
         # Fleet mode (ISSUE 7): N in-process replicas (one weight tree,
@@ -1268,6 +1428,16 @@ def build_server(args) -> tuple:
             )
         plen = engine.set_prefix(args.prefix_prompt, pixels)
         print(f"[serve] shared prefix cached: {plen} positions")
+    return cfg, engine
+
+
+def build_server(args) -> tuple:
+    """(ThreadingHTTPServer, engine) — separated from main() so tests
+    can run the real stack in-process on an ephemeral port. The engine
+    may be a single ``ServingEngine``, a thread ``Fleet`` or a
+    ``ProcFleet`` coordinator; the handler serves all three through
+    the same surface."""
+    cfg, engine = build_engine(args)
     default_deadline = getattr(args, "default_deadline_s", 0) or None
     # Per-class SLO targets (ISSUE 6): a payload {"slo_class": ...}
     # scores the request against these at finish (0 disarms a target).
@@ -1397,6 +1567,53 @@ def main(argv=None):
                         "prefix-affinity router (0/1 = single engine). "
                         "Replicas share the weight tree; each owns its "
                         "resident KV cache and scheduler thread")
+    # -- process fleet (ISSUE 11; DISTRIBUTED.md "Process fleet") --
+    p.add_argument("--proc_fleet", type=int, default=0,
+                   help="run N worker PROCESSES (each a full "
+                        "ServingEngine + model + jax runtime) behind "
+                        "the RPC coordinator (0/1 = single engine). "
+                        "Separate failure domains: a worker death is "
+                        "drained/redone onto survivors and the slot "
+                        "respawns with backoff")
+    p.add_argument("--worker", action="store_true",
+                   help="run as one process-fleet worker: build a "
+                        "single engine and serve the length-prefixed "
+                        "JSON-over-TCP RPC ops instead of HTTP "
+                        "(spawned by the --proc_fleet coordinator; "
+                        "needs --worker_ready_file)")
+    p.add_argument("--worker_ready_file", default=None,
+                   help="path the worker writes its "
+                        "{port, pid} readiness handshake to")
+    p.add_argument("--worker_slot", type=int, default=0,
+                   help="the coordinator slot index this worker fills "
+                        "(informational: logs/heartbeat labelling)")
+    p.add_argument("--drain_timeout_s", type=float, default=30.0,
+                   help="graceful-shutdown bound: seconds SIGTERM/"
+                        "SIGINT (and proc-fleet coordinator shutdown) "
+                        "waits for in-flight requests before exiting")
+    p.add_argument("--procfleet_rpc_deadline_s", type=float, default=15.0,
+                   help="per-op RPC deadline the coordinator gives a "
+                        "worker call (connect + send + response)")
+    p.add_argument("--procfleet_rpc_retries", type=int, default=3,
+                   help="transport-failure retries per RPC call "
+                        "(exponential backoff + jitter under the "
+                        "deadline; mutating ops never retry after "
+                        "their bytes were sent)")
+    p.add_argument("--procfleet_spawn_timeout_s", type=float,
+                   default=180.0,
+                   help="seconds a spawned worker may take to become "
+                        "ready before the slot books a crash")
+    p.add_argument("--procfleet_respawn_backoff_s", type=float,
+                   default=0.25,
+                   help="initial per-slot respawn backoff after a "
+                        "worker death (doubles per consecutive crash)")
+    p.add_argument("--procfleet_crash_window_s", type=float, default=60.0,
+                   help="crash-loop window: crashes older than this "
+                        "stop counting toward the breaker")
+    p.add_argument("--procfleet_crash_limit", type=int, default=3,
+                   help="crashes inside the window that trip the "
+                        "slot's crash-loop breaker (the fleet gives "
+                        "the slot up and degrades capacity)")
     p.add_argument("--fleet_shed_goodput", type=float, default=0.5,
                    help="shed batch-class requests while the aggregate "
                         "windowed goodput ratio is below this "
@@ -1462,17 +1679,59 @@ def main(argv=None):
     p.add_argument("--pretrain_attention_layers", default=None)
     args = p.parse_args(argv)
 
+    if args.worker:
+        # Process-fleet worker (ISSUE 11): one engine, RPC instead of
+        # HTTP. serve_worker installs its own SIGTERM/SIGINT handlers
+        # (stop -> engine.shutdown -> exit 0).
+        if not args.worker_ready_file:
+            p.error("--worker requires --worker_ready_file")
+        from eventgpt_tpu.fleet_proc import serve_worker
+
+        _, engine = build_engine(args, force_single=True)
+        return serve_worker(engine, args.worker_ready_file)
+
     httpd, engine = build_server(args)
     host, port = httpd.server_address[:2]
     print(f"[serve] listening on http://{host}:{port} "
           f"(max_batch={args.max_batch}, chunk={args.chunk})")
+
+    # Graceful drain (ISSUE 11 satellite): SIGTERM/SIGINT stop
+    # ADMISSION (the accept loop), let in-flight requests finish
+    # (bounded by --drain_timeout_s) so their handler threads write
+    # complete responses, then exit 0 — a signal mid-decode no longer
+    # kills committed work. httpd.shutdown() must run off the signal
+    # handler's thread (it joins the serve_forever loop).
+    import signal as _signal
+
+    got_signal = threading.Event()
+
+    def _on_signal(signum, frame):
+        got_signal.set()
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    _signal.signal(_signal.SIGTERM, _on_signal)
+    _signal.signal(_signal.SIGINT, _on_signal)
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        if got_signal.is_set():
+            deadline = time.monotonic() + args.drain_timeout_s
+            print("[serve] draining in-flight requests "
+                  f"(<= {args.drain_timeout_s:.0f}s)")
+            while time.monotonic() < deadline:
+                s = engine.stats()
+                if not (s.get("active_rows", 0) or s.get("queued", 0)):
+                    break
+                time.sleep(0.05)
+            # One breath for handler threads to finish writing the
+            # responses of requests that just left the engine.
+            time.sleep(0.25)
         engine.shutdown()
         httpd.server_close()
+        if got_signal.is_set():
+            print("[serve] drained, exiting")
 
 
 if __name__ == "__main__":

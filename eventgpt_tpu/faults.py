@@ -47,7 +47,14 @@ untouched), ``serve.prefix_copy`` (prefix-cache entry copy at admission),
 degrades that submit to least-queue routing, a probe fault marks the
 probed replica unroutable until a clean probe, and a replica_kill trip
 IS the scripted chaos kill — the supervisor kills a live replica and
-must drain + re-route its requests to survivors), ``multiproc.launch``
+must drain + re-route its requests to survivors), ``procfleet.rpc`` /
+``procfleet.spawn`` / ``procfleet.worker_kill`` (the process fleet,
+``rpc.py`` + ``fleet_proc.py``: an rpc trip is a transport failure the
+bounded-backoff retry loop must absorb, a spawn trip fails that worker
+spawn attempt — booked as a crash, so the respawn-backoff/crash-loop
+policy governs it — and a worker_kill trip IS the scripted SIGKILL of
+the busiest worker, whose requests the coordinator must redo on
+survivors), ``multiproc.launch``
 / ``multiproc.worker`` (``parallel/multiproc.py`` bootstrap), and
 ``train.step`` (``Trainer`` micro-batch boundary).
 

@@ -67,14 +67,18 @@ from typing import Any, Dict, List, Optional, Tuple
 #   route           the fleet router placed the request on a replica
 #   repin           failover moved the session's affinity pin
 #   failover        the request re-routed to a survivor (re-decode)
+#   worker_lost     the request's worker PROCESS died hard (SIGKILL /
+#                   crash / unreachable) — the redo failover follows
+#   respawn         the coordinator spawned a replacement process into
+#                   the lost worker's slot while this request was live
 #   nan_quarantine / deadline / cancel   forced-finish markers
 #   exported        the replica drained it for re-admission elsewhere
 #   finish          terminal bookkeeping (status + slo_met)
 EVENT_KINDS = (
     "submit", "queue", "prefix", "mem_guard_defer", "lane_join",
     "lane_finish", "admit", "segment", "shed", "route", "repin",
-    "failover", "nan_quarantine", "deadline", "cancel", "exported",
-    "finish",
+    "failover", "worker_lost", "respawn", "nan_quarantine", "deadline",
+    "cancel", "exported", "finish",
 )
 
 # The CLOSED dominant-miss-cause enum. It is the ``cause`` label of

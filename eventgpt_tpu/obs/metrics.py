@@ -70,7 +70,9 @@ METRIC_LABELS = {
         # site cannot ship without extending the enum); "other" absorbs
         # synthetic/ad-hoc drill sites (faults._site_label clamps).
         "site": ("fleet.probe", "fleet.replica_kill", "fleet.route",
-                 "multiproc.launch", "multiproc.worker", "serve.admit",
+                 "multiproc.launch", "multiproc.worker",
+                 "procfleet.rpc", "procfleet.spawn",
+                 "procfleet.worker_kill", "serve.admit",
                  "serve.dispatch", "serve.loop", "serve.mem_guard",
                  "serve.mixed_dispatch", "serve.prefix_copy", "serve.step",
                  "train.step", "other"),
@@ -105,6 +107,13 @@ METRIC_LABELS = {
     },
     "egpt_serve_slo_latency_seconds": {
         "slo_class": ("interactive", "batch"),
+    },
+    "egpt_procfleet_failovers_total": {
+        # How a lost worker's requests moved (ISSUE 11): drain = the
+        # worker still answered RPC and export_requests() re-routed its
+        # in-flight work; redo = the worker died hard (SIGKILL/crash)
+        # and the coordinator re-submitted from its own records.
+        "path": ("drain", "redo"),
     },
     "egpt_serve_slo_miss_cause_total": {
         # The flight recorder's dominant-miss-cause enum (obs/journey.py
@@ -612,6 +621,43 @@ FLEET_REPLICA_DEATHS = REGISTRY.counter(
     "egpt_fleet_replica_deaths_total",
     "Replica kills observed by the supervisor (chaos fleet.replica_kill "
     "trips and operator kill_replica calls)")
+
+# -- process fleet: worker processes behind the RPC coordinator
+#    (ISSUE 11, eventgpt_tpu/fleet_proc.py + rpc.py) --
+# Aggregate-only like the egpt_fleet_* family (a per-slot label would
+# be computed — lint rule 5); per-worker numbers live in /fleet and
+# the PROCFLEET bench artifact.
+PROCFLEET_WORKERS = REGISTRY.gauge(
+    "egpt_procfleet_workers",
+    "Configured worker-process slots in the process fleet")
+PROCFLEET_ROUTABLE = REGISTRY.gauge(
+    "egpt_procfleet_workers_routable",
+    "Worker processes currently in the routing pool (ready, heartbeat "
+    "fresh, answering RPC, not crash-looped)")
+PROCFLEET_RPC_RETRIES = REGISTRY.counter(
+    "egpt_procfleet_rpc_retries_total",
+    "RPC attempts retried after a transport failure (refused/reset "
+    "connection, short read, injected procfleet.rpc trip) — each retry "
+    "backed off exponentially with jitter under the per-call deadline")
+PROCFLEET_WORKER_DEATHS = REGISTRY.counter(
+    "egpt_procfleet_worker_deaths_total",
+    "Worker processes lost: unexpected exits (SIGKILL/crash), "
+    "stale-heartbeat/unreachable drains, and operator kill_worker calls")
+PROCFLEET_RESPAWNS = REGISTRY.counter(
+    "egpt_procfleet_respawns_total",
+    "Worker processes respawned into a dead slot (per-slot exponential "
+    "backoff; stops when the crash-loop breaker gives the slot up)")
+PROCFLEET_FAILOVERS = REGISTRY.counter(
+    "egpt_procfleet_failovers_total",
+    "Requests moved off a lost worker, by path: drain (exported over "
+    "RPC from a still-answering worker) or redo (re-submitted from the "
+    "coordinator's own records after a hard death); both re-decode "
+    "from the prompt, so greedy chains stay byte-identical")
+PROCFLEET_CRASH_LOOPS = REGISTRY.counter(
+    "egpt_procfleet_crash_loop_slots_total",
+    "Worker slots the crash-loop breaker gave up on (K crashes inside "
+    "the window): capacity degrades, /health stays green while any "
+    "other worker is routable")
 
 # -- HBM memory ledger (ISSUE 9, eventgpt_tpu/obs/memory.py) --
 MEM_COMPONENT = REGISTRY.gauge(

@@ -1,0 +1,309 @@
+"""Minimal length-prefixed JSON-over-TCP RPC for the process fleet.
+
+The process-fleet tier (ISSUE 11, ``fleet_proc.py``) needs exactly one
+thing from a transport: move small host-side records (token ids, pixel
+arrays, stats dicts) between a coordinator and worker processes on
+localhost, and FAIL LOUDLY AND BOUNDEDLY when the other side is slow,
+wedged, or dead. This module is that transport and nothing more — no
+pickling (a killed worker must never be able to corrupt the
+coordinator beyond a parse error), no connection pooling, no service
+discovery. One call = one connection = one request + one response,
+each framed as a 4-byte big-endian length prefix + UTF-8 JSON.
+
+Robustness contract (the tentpole's layer 1):
+
+  * **Every call carries a deadline.** ``call(..., deadline_s=...)``
+    bounds the WHOLE call — connect, send, and the response read all
+    share one budget; exhausting it raises ``RpcTimeout``. A worker
+    that stops answering costs the caller ``deadline_s``, never a hung
+    thread.
+  * **Bounded exponential backoff + jitter.** Transport failures
+    (refused/reset connections, short reads, injected
+    ``procfleet.rpc`` trips) retry up to ``retries`` times with
+    ``backoff_s * 2^attempt`` sleeps (capped, jittered to decorrelate
+    a thundering coordinator) while the deadline allows.
+  * **Mutating ops never blind-retry.** A retry after the request
+    bytes left the socket could double-submit a request whose first
+    copy was actually delivered (the response, not the request, was
+    lost). Callers pass ``retry_sent=False`` for non-idempotent ops:
+    failures before the payload is sent retry normally; failures after
+    it raise immediately and the caller decides (the coordinator
+    treats that worker as suspect and re-routes).
+  * **Remote exceptions are data.** A handler exception returns as
+    ``{"error": {"type", "msg"}}`` and re-raises as
+    ``RpcRemoteError`` — never retried (the op REACHED the worker; the
+    failure is semantic, e.g. ``QueueFullError``, and the caller maps
+    it back to the engine exception it mirrors).
+
+The fault site ``procfleet.rpc`` fires per ATTEMPT, before any bytes
+move — a transport-shaped failure the retry loop must absorb — so the
+chaos tests drive the real retry/backoff path, not a mock.
+
+Wire values beyond JSON: numpy arrays ride as
+``{"__nd__": [shape, dtype, b64]}`` (bit-exact round trip — the chain
+identity tests depend on pixels surviving verbatim), bytes as
+``{"__b64__": ...}``, and the ``workload.SLO`` dataclass as
+``{"__slo__": {...}}`` (an allowlisted type, not arbitrary class
+hydration). Deliberately jax-free.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import random
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from eventgpt_tpu import faults
+from eventgpt_tpu.obs import metrics as obs_metrics
+
+_LEN = struct.Struct(">I")
+# One frame must hold a pixel stream (tiny: ~60 KB b64) or an exported
+# request batch; 64 MiB is far above any legitimate record and far
+# below "a corrupt length prefix allocates the host away".
+MAX_MSG_BYTES = 64 * 1024 * 1024
+
+class RpcError(RuntimeError):
+    """Transport/protocol failure talking to a worker (connect refused,
+    reset, short read, frame too large, deadline pressure)."""
+
+
+class RpcTimeout(RpcError):
+    """The per-call deadline elapsed before a response arrived."""
+
+
+class RpcRemoteError(RuntimeError):
+    """The worker's handler raised: ``type_name`` is the remote
+    exception class name (the coordinator maps known names back onto
+    the engine exceptions they mirror, e.g. ``QueueFullError``)."""
+
+    def __init__(self, type_name: str, msg: str):
+        super().__init__(f"{type_name}: {msg}")
+        self.type_name = type_name
+        self.remote_msg = msg
+
+
+# -- wire encoding ---------------------------------------------------------
+
+def _enc_default(o):
+    import numpy as np
+
+    if isinstance(o, np.ndarray):
+        arr = np.ascontiguousarray(o)
+        return {"__nd__": [list(arr.shape), str(arr.dtype),
+                           base64.b64encode(arr.tobytes()).decode()]}
+    if isinstance(o, np.integer):
+        return int(o)
+    if isinstance(o, np.floating):
+        return float(o)
+    if isinstance(o, (bytes, bytearray)):
+        return {"__b64__": base64.b64encode(bytes(o)).decode()}
+    # SLO is the one dataclass that crosses the boundary (submit_ids /
+    # export_requests records). Encoded by field, decoded through the
+    # real constructor — an allowlist of one, not generic hydration.
+    from eventgpt_tpu.workload import SLO
+
+    if isinstance(o, SLO):
+        return {"__slo__": {"name": o.name, "ttft_s": o.ttft_s,
+                            "itl_s": o.itl_s, "latency_s": o.latency_s}}
+    raise TypeError(f"cannot encode {type(o).__name__} for RPC")
+
+
+def _dec_hook(d: Dict[str, Any]):
+    if "__nd__" in d and len(d) == 1:
+        import numpy as np
+
+        shape, dtype, b64 = d["__nd__"]
+        return np.frombuffer(
+            base64.b64decode(b64), dtype=np.dtype(dtype)
+        ).reshape(shape).copy()
+    if "__b64__" in d and len(d) == 1:
+        return base64.b64decode(d["__b64__"])
+    if "__slo__" in d and len(d) == 1:
+        from eventgpt_tpu.workload import SLO
+
+        return SLO(**d["__slo__"])
+    return d
+
+
+def dumps(obj: Any) -> bytes:
+    return json.dumps(obj, default=_enc_default).encode()
+
+
+def loads(data: bytes) -> Any:
+    return json.loads(data.decode(), object_hook=_dec_hook)
+
+
+# -- framing ---------------------------------------------------------------
+
+def send_msg(sock: socket.socket, data: bytes) -> None:
+    if len(data) > MAX_MSG_BYTES:
+        raise RpcError(f"message of {len(data)} bytes exceeds the "
+                       f"{MAX_MSG_BYTES}-byte frame cap")
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise RpcError(f"connection closed mid-frame "
+                           f"({len(buf)}/{n} bytes)")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> bytes:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > MAX_MSG_BYTES:
+        raise RpcError(f"frame of {n} bytes exceeds the "
+                       f"{MAX_MSG_BYTES}-byte cap (corrupt prefix?)")
+    return _recv_exact(sock, n)
+
+
+# -- client ----------------------------------------------------------------
+
+def call(addr: Tuple[str, int], op: str, payload: Optional[dict] = None,
+         *, deadline_s: float = 10.0, retries: int = 3,
+         backoff_s: float = 0.05, backoff_max_s: float = 2.0,
+         retry_sent: bool = True) -> Any:
+    """One RPC against ``addr``: returns the handler's result.
+
+    ``deadline_s`` bounds the whole call (all attempts + backoffs).
+    ``retries`` bounds the transport-failure retry count.
+    ``retry_sent=False`` marks the op non-idempotent: a failure AFTER
+    the request bytes were sent raises instead of retrying (see the
+    module docstring). Raises ``RpcTimeout`` / ``RpcError`` on
+    transport exhaustion, ``RpcRemoteError`` on a handler exception
+    (never retried — the op reached the worker)."""
+    t_deadline = time.monotonic() + float(deadline_s)
+    request = dumps({"op": op, "payload": payload or {}})
+    attempt = 0
+    last: Optional[BaseException] = None
+    # Host-timing jitter only (never touches decoded chains): an
+    # unseeded RNG is exactly right — correlated coordinator retries
+    # are the failure mode jitter exists to break.
+    rng = random.Random()
+    while True:
+        sent = False
+        try:
+            # The chaos seam (tentpole layer 1): a trip here IS a
+            # transport failure, upstream of any socket work, so the
+            # handling below — classify, back off, retry, give up at
+            # the deadline — is the same code path a real refused
+            # connection takes.
+            faults.maybe_fail("procfleet.rpc")
+            faults.maybe_delay("procfleet.rpc")
+            remaining = t_deadline - time.monotonic()
+            if remaining <= 0:
+                raise RpcTimeout(
+                    f"rpc {op!r} to {addr}: deadline of {deadline_s}s "
+                    f"exhausted after {attempt} attempt(s)")
+            with socket.create_connection(addr, timeout=remaining) as s:
+                s.settimeout(max(t_deadline - time.monotonic(), 0.001))
+                sent = True
+                send_msg(s, request)
+                resp = loads(recv_msg(s))
+            if "error" in resp:
+                err = resp["error"]
+                raise RpcRemoteError(err.get("type", "RuntimeError"),
+                                     err.get("msg", ""))
+            return resp.get("result")
+        except RpcRemoteError:
+            raise
+        except (OSError, RpcError, faults.InjectedFault, ValueError) as e:
+            last = e
+            attempt += 1
+            if sent and not retry_sent:
+                raise RpcError(
+                    f"rpc {op!r} to {addr} failed after the request was "
+                    f"sent; not retried (non-idempotent): {e!r}") from e
+            if attempt > retries or time.monotonic() >= t_deadline:
+                if isinstance(e, RpcTimeout) \
+                        or time.monotonic() >= t_deadline:
+                    raise RpcTimeout(
+                        f"rpc {op!r} to {addr} timed out after "
+                        f"{attempt} attempt(s): {last!r}") from e
+                raise RpcError(
+                    f"rpc {op!r} to {addr} failed after {attempt} "
+                    f"attempt(s): {last!r}") from e
+            obs_metrics.PROCFLEET_RPC_RETRIES.inc()
+            delay = min(backoff_s * (2.0 ** (attempt - 1)), backoff_max_s)
+            delay *= 1.0 + 0.5 * rng.random()  # decorrelating jitter
+            time.sleep(max(min(delay, t_deadline - time.monotonic()), 0.0))
+
+
+# -- server ----------------------------------------------------------------
+
+class RpcServer:
+    """Thread-per-connection server over a handler callable
+    ``handler(op, payload) -> result``. One call per connection (the
+    client's connection-per-call discipline keeps both sides free of
+    pooled-socket state). Handler exceptions become ``{"error": ...}``
+    responses; transport errors on one connection never touch another.
+
+    Shared state is two self-synchronizing primitives (the bound
+    socket, closed exactly once via ``_stop``'s Event gate) — there is
+    deliberately no mutable map for egpt-check's lock rule to guard.
+    """
+
+    def __init__(self, handler: Callable[[str, dict], Any],
+                 host: str = "127.0.0.1", port: int = 0,
+                 read_timeout_s: float = 30.0):
+        self._handler = handler
+        self._read_timeout_s = float(read_timeout_s)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.addr: Tuple[str, int] = self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self.addr[1]
+
+    def stop(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        try:
+            self._sock.close()  # unblocks accept()
+        except OSError:
+            pass
+        self._thread.join(timeout=5)
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break  # socket closed by stop()
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        with conn:
+            try:
+                conn.settimeout(self._read_timeout_s)
+                msg = loads(recv_msg(conn))
+            except (OSError, RpcError, ValueError):
+                return  # half-open/garbage connection: drop it
+            try:
+                result = self._handler(msg.get("op", ""),
+                                       msg.get("payload") or {})
+                resp = {"result": result}
+            except Exception as e:  # handler errors are DATA (see doc)
+                resp = {"error": {"type": type(e).__name__,
+                                  "msg": str(e)}}
+            try:
+                send_msg(conn, dumps(resp))
+            except (OSError, RpcError, TypeError):
+                pass  # client went away / unencodable: nothing to do

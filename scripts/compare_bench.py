@@ -148,8 +148,16 @@ def _trace_identity(rec: Dict[str, Any]) -> Optional[Tuple]:
         return None
     if "output_min" not in r or "output_max" not in r:
         return ()
+    # proc_fleet joins the identity (ISSUE 11): N separate jax worker
+    # PROCESSES contend for the same host CPUs, so tok_s across
+    # process topologies measures the contention regime, not the
+    # server — same-trace thread-fleet vs process-fleet records drop
+    # tok_s with an unpaired note. (The in-process --fleet key stays
+    # OUT of the identity on purpose: thread replicas share one
+    # runtime, and the fleet-vs-single tok_s gate is load-bearing.)
     return (r.get("requests"), r.get("seed"), r.get("arrival"),
-            r.get("sessions"), r["output_min"], r["output_max"])
+            r.get("sessions"), r["output_min"], r["output_max"],
+            r.get("proc_fleet"))
 
 
 def compare(base: Dict[str, Any], new: Dict[str, Any],
@@ -182,8 +190,8 @@ def compare(base: Dict[str, Any], new: Dict[str, Any],
     # point's ledger peak covers N resident caches, a single-engine
     # point's covers one — cross-topology "regressions" there would be
     # architecture, not drift. Same design as the tok_s identity rule.
-    bt = _unwrap(base).get("fleet")
-    nt = _unwrap(new).get("fleet")
+    bt = (_unwrap(base).get("fleet"), _unwrap(base).get("proc_fleet"))
+    nt = (_unwrap(new).get("fleet"), _unwrap(new).get("proc_fleet"))
     if bt != nt:
         dropped = sorted(k for k in set(b) | set(n)
                          if "mem_peak" in k or ".memory." in k
@@ -194,8 +202,8 @@ def compare(base: Dict[str, Any], new: Dict[str, Any],
         if dropped:
             notes.append(
                 f"unpaired   memory ({len(dropped)} key(s)) not gated: "
-                f"replica topology differs (base fleet={bt}, new "
-                f"fleet={nt}) — ledger peaks only pair within one "
+                f"replica topology differs (base fleet/proc={bt}, new "
+                f"fleet/proc={nt}) — ledger peaks only pair within one "
                 f"topology")
     for key in sorted(set(b) & set(n)):
         d = direction(key)

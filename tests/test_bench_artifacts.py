@@ -138,6 +138,91 @@ def test_fleet_workload_artifact_schema():
                 assert rep["memory_bytes"] > 0, (p, rep["replica"])
 
 
+def test_procfleet_workload_artifact_schema():
+    """ISSUE 11 acceptance shape: >= 2 worker processes, >= 2
+    offered-load points, per-worker goodput / hit-ratio / OWN-process
+    ledger bytes in every sweep leg, and the stitched attribution keys
+    (failover_redo is a real phase across the process boundary)."""
+    paths = sorted(glob.glob(
+        os.path.join(ROOT, "WORKLOAD_PROCFLEET_r0*.json")))
+    assert paths, "no WORKLOAD_PROCFLEET_r0*.json checked in"
+    for p in paths:
+        rec = _load(p)
+        assert rec["metric"].startswith("workload_procfleet_goodput_"), p
+        assert rec["proc_fleet"] >= 2, f"{p}: need >= 2 workers"
+        # Output-cap identity keys: same trace as the fleet artifact,
+        # but tok_s still must NOT pair cross-process-topology — the
+        # proc_fleet key joins the identity for that.
+        for k in ("output_min", "output_max", "trace_output_tokens"):
+            assert isinstance(rec.get(k), int), (p, k)
+        sweep = rec["sweep"]
+        assert len(sweep) >= 2, f"{p}: need >= 2 offered-load points"
+        for leg in sweep:
+            for k in ("rate_mult", "goodput_rps", "slo_met_ratio",
+                      "tok_s", "prefix_cache_hit_ratio", "classes",
+                      "rejected_total", "failovers", "worker_deaths",
+                      "respawns", "workers", "miss_causes", "slowest"):
+                assert k in leg, (p, k)
+            assert len(leg["classes"]) >= 2, \
+                f"{p}: need >= 2 SLO classes per point"
+            for cname, c in leg["classes"].items():
+                assert "failover_redo_p99_s" in c, (p, cname)
+                assert "attribution" in c, (p, cname)
+            assert len(leg["workers"]) == rec["proc_fleet"], p
+            for w in leg["workers"]:
+                for k in ("worker", "requests", "goodput_rps",
+                          "slo_met_ratio", "prefix_cache_hit_ratio",
+                          "memory_bytes"):
+                    assert k in w, (p, k)
+                # Each worker is its OWN process: its ledger share is
+                # real and nonzero (weights are NOT shared here).
+                assert w["memory_bytes"] > 0, (p, w["worker"])
+
+
+def test_compare_bench_gates_procfleet_vs_fleet_workload():
+    """ISSUE 11 satellite: compare_bench gates the process-fleet
+    artifact against the thread-fleet one on the SERVICE-QUALITY keys
+    (goodput / slo_met / attainment, paired by rate_mult) while the
+    throughput/memory keys — tok_s (N jax processes timeshare the
+    host CPUs) and ledger bytes (N ledgers vs one) — drop with
+    ``unpaired`` notes, per the PR 8/9 identity convention. Degrading
+    the procfleet goodput must fire: the gate has teeth."""
+    mod = _compare_mod()
+    base = _load(sorted(glob.glob(
+        os.path.join(ROOT, "WORKLOAD_FLEET_r0*.json")))[0])
+    new = _load(sorted(glob.glob(
+        os.path.join(ROOT, "WORKLOAD_PROCFLEET_r0*.json")))[0])
+    require = ("goodput_rps", "slo_met_ratio", "attainment")
+    regs, notes = mod.compare(base, new, require=require)
+    assert regs == [], \
+        f"procfleet artifact regressed the SLO-goodput keys: {regs}"
+    assert any("unpaired" in n and "tok_s" in n for n in notes), notes
+    assert any("unpaired" in n and "memory" in n for n in notes), notes
+    worse = json.loads(json.dumps(new))
+    for leg in worse["sweep"]:
+        leg["goodput_rps"] *= 0.5
+    regs, _ = mod.compare(base, worse, require=require)
+    assert any("goodput_rps" in r for r in regs)
+
+
+def test_compare_bench_proc_topology_joins_trace_identity():
+    """The proc_fleet key is part of the tok_s pairing identity: the
+    SAME record with a different process topology stops pairing tok_s
+    (dropped + noted), while self-comparison still gates it."""
+    mod = _compare_mod()
+    rec = _load(sorted(glob.glob(
+        os.path.join(ROOT, "WORKLOAD_PROCFLEET_r0*.json")))[0])
+    regs, _ = mod.compare(rec, rec, require=("tok_s",))
+    assert regs == [], f"tok_s must be self-comparable: {regs}"
+    other = json.loads(json.dumps(rec))
+    other["proc_fleet"] = rec["proc_fleet"] + 2
+    for leg in other["sweep"]:
+        leg["tok_s"] *= 0.3  # would fire if (wrongly) paired
+    regs, notes = mod.compare(rec, other)
+    assert not any("tok_s" in r for r in regs)
+    assert any("unpaired" in n and "tok_s" in n for n in notes)
+
+
 def test_compare_bench_gates_fleet_vs_single_workload():
     """ISSUE 7/8 satellite: compare_bench is the tier-1 smoke gate over
     the checked-in fleet artifact vs WORKLOAD_r01.json. Since ISSUE 8

@@ -351,6 +351,31 @@ def test_label_rule_journey_enum_cross_checks(tmp_path):
     assert any("journey event kind 'bogus_kind'" in m for m in msgs), msgs
 
 
+def test_journey_kind_cross_check_picks_up_procfleet_members():
+    """ISSUE 11 satellite: the kind cross-check reads EVENT_KINDS from
+    the REAL obs/journey.py literal, so the new process-fleet members
+    (worker_lost / respawn — recorded by fleet_proc.py call sites) are
+    accepted without any rule change; the repo self-check above is
+    what enforces it tree-wide."""
+    import ast
+    import os
+
+    from eventgpt_tpu.obs.journey import EVENT_KINDS
+
+    assert "worker_lost" in EVENT_KINDS and "respawn" in EVENT_KINDS
+    # The enum stays a PURE LITERAL (the cross-check reads it with
+    # ast.literal_eval, no imports).
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = open(os.path.join(root, "eventgpt_tpu", "obs",
+                            "journey.py")).read()
+    tree = ast.parse(src)
+    lits = [ast.literal_eval(node.value) for node in ast.walk(tree)
+            if isinstance(node, ast.Assign)
+            and any(getattr(t, "id", None) == "EVENT_KINDS"
+                    for t in node.targets)]
+    assert lits == [EVENT_KINDS]
+
+
 def test_malformed_waivers_are_findings(tmp_path):
     pkg = _pkg(tmp_path)
     (pkg / "x.py").write_text(

@@ -1,0 +1,206 @@
+"""Coordinator-logic tests for the process fleet (ISSUE 11,
+eventgpt_tpu/fleet_proc.py), run against the jax-free STUB worker
+(``--stub_worker``: the same RPC surface over a deterministic fake
+engine, sub-second startup) so spawn / retry / respawn / crash-loop
+policy is exercised in real OS processes without paying a jax import
+per worker. The real-engine chain-identity and SIGKILL chaos tests
+live in tests/test_fleet_proc_chaos.py."""
+
+import time
+
+import numpy as np
+import pytest
+
+from eventgpt_tpu import faults
+from eventgpt_tpu.fleet_proc import ProcFleet, stub_worker_cmd
+from eventgpt_tpu.obs import journey as obs_journey
+
+EVENT = -200  # constants.EVENT_TOKEN_INDEX (jax-free literal on purpose)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.disable()
+    obs_journey.configure(256)
+    yield
+    faults.disable()
+    obs_journey.disable()
+
+
+def _pv(seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(2, 3, 4, 4)).astype(np.float32)
+
+
+def _stub_chain(ids, budget):
+    s = sum(ids)
+    return [(s + k) % 251 for k in range(budget)]
+
+
+def _fleet(n=2, **kw):
+    kw.setdefault("spawn_timeout_s", 60)
+    kw.setdefault("probe_interval_s", 0.02)
+    delay = kw.pop("token_delay_s", 0.002)
+    return ProcFleet(stub_worker_cmd(delay), n, **kw)
+
+
+def test_event_kinds_gained_procfleet_members():
+    """The closed journey enum carries the new process-fleet kinds
+    (the egpt-check rule-5 cross-check reads the same literal, so
+    fleet_proc.py's call sites are statically verified against it)."""
+    assert "worker_lost" in obs_journey.EVENT_KINDS
+    assert "respawn" in obs_journey.EVENT_KINDS
+
+
+def test_submit_result_roundtrip_and_affinity_pin():
+    fleet = _fleet()
+    try:
+        ids = [1, 2, EVENT, 7]
+        fr = fleet.submit_ids(ids, _pv(1), 6)
+        assert fleet.result(fr, timeout=60) == _stub_chain(ids, 6)
+        first = fleet.worker_of(fr)
+        # Same head + same pixels => same affinity key => same worker.
+        fr2 = fleet.submit_ids(ids, _pv(1), 4)
+        assert fleet.result(fr2, timeout=60) == _stub_chain(ids, 4)
+        assert fleet.worker_of(fr2) == first
+        st = fleet.stats()
+        assert st["fleet"]["workers"] == 2
+        assert st["fleet"]["routable"] == 2
+        assert st["fleet"]["pins"] >= 1
+        fl = fleet.fleet_stats()
+        assert fl["policy"]["crash_limit"] == 3
+        j = fleet.journey(fr)
+        kinds = [e["kind"] for e in j["events"]]
+        assert kinds[0] == "submit" and "route" in kinds
+        assert j["finished"] and j["status"] == "ok"
+    finally:
+        fleet.shutdown()
+
+
+def test_rpc_fault_retried_under_live_traffic():
+    """``procfleet.rpc:n=K`` trips one real coordinator->worker call;
+    the bounded-backoff retry absorbs it and every request still
+    finishes with the right chain."""
+    faults.configure("procfleet.rpc:n=3")
+    fleet = _fleet()
+    try:
+        ids = [1, 2, EVENT, 9]
+        frs = [fleet.submit_ids(ids, _pv(i), 5) for i in range(3)]
+        for fr in frs:
+            assert fleet.result(fr, timeout=60) == _stub_chain(ids, 5)
+        assert faults.stats()["procfleet.rpc"]["fires"] == 1
+    finally:
+        fleet.shutdown()
+
+
+def test_spawn_fault_booked_as_crash_and_respawned():
+    """``procfleet.spawn:n=1`` fails the first spawn attempt; the slot
+    books a crash and the backoff/respawn path still brings the full
+    fleet up (the handling contract for a failed exec)."""
+    faults.configure("procfleet.spawn:n=1")
+    fleet = _fleet(respawn_backoff_s=0.05)
+    try:
+        assert faults.stats()["procfleet.spawn"]["fires"] == 1
+        assert all(s.state == "ok" for s in fleet.slots)
+        assert sum(s.routable for s in fleet.slots) == 2
+        ids = [1, 2, EVENT, 3]
+        fr = fleet.submit_ids(ids, _pv(0), 4)
+        assert fleet.result(fr, timeout=60) == _stub_chain(ids, 4)
+    finally:
+        fleet.shutdown()
+
+
+def test_crash_loop_breaker_gives_up_slot_health_stays_green():
+    """K crashes inside the window trip the slot's crash-loop breaker:
+    the slot is given up (state ``failed``, no further respawns),
+    capacity degrades, and /health stays green because the other
+    worker still serves."""
+    fleet = _fleet(respawn_backoff_s=0.05, respawn_backoff_max_s=0.2,
+                   crash_limit=3, crash_window_s=60.0)
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and fleet.slots[0].state != "failed":
+            if fleet.slots[0].state == "ok" \
+                    and fleet.slots[0].proc is not None:
+                fleet.kill_worker(0)
+            time.sleep(0.01)
+        assert fleet.slots[0].state == "failed", \
+            f"breaker never tripped: {fleet.slots[0].state}"
+        assert fleet.n_crash_looped == 1
+        assert len(fleet.slots[0].crashes) >= 3
+        # Degraded capacity, green health: the fleet still serves.
+        assert not fleet.breaker_open()
+        assert sum(s.routable for s in fleet.slots) == 1
+        ids = [1, 2, EVENT, 5]
+        fr = fleet.submit_ids(ids, _pv(9), 4)
+        assert fleet.result(fr, timeout=60) == _stub_chain(ids, 4)
+        # The failed slot stays failed: no respawn resurrects it.
+        time.sleep(0.3)
+        assert fleet.slots[0].state == "failed"
+    finally:
+        fleet.shutdown()
+
+
+def test_graceful_drain_reroutes_inflight_requests():
+    """Operator drain: export_requests over RPC strips the worker's
+    in-flight work and re-routes it (path=drain); chains are identical
+    to an undisturbed run and the slot respawns afterwards."""
+    fleet = _fleet(token_delay_s=0.05, respawn_backoff_s=0.05)
+    try:
+        ids = [1, 2, EVENT, 6]
+        # Slow stub decode (0.05 * 30 = 1.5 s): the drain lands mid-run.
+        frs = [fleet.submit_ids(ids, _pv(i), 30) for i in range(4)]
+        time.sleep(0.2)
+        busy = max(fleet.slots, key=lambda s: s.inflight)
+        moved = fleet.drain_worker(busy.idx)
+        assert moved >= 1, "drain found nothing in flight"
+        for fr in frs:
+            assert fleet.result(fr, timeout=60) == _stub_chain(ids, 30)
+        assert fleet.n_kills == 1
+        assert fleet.n_failovers >= moved
+        moved_frids = [f for f in frs
+                       if fleet._requests[f].failovers >= 1]
+        assert moved_frids
+        j = fleet.journey(moved_frids[0])
+        kinds = [e["kind"] for e in j["events"]]
+        # Drain path: failover WITHOUT worker_lost (the worker answered).
+        assert "failover" in kinds and "worker_lost" not in kinds
+        ev = next(e for e in j["events"] if e["kind"] == "failover")
+        assert ev["path"] == "drain"
+        # Respawn recovery re-admits the slot.
+        deadline = time.time() + 60
+        while time.time() < deadline and not all(
+                s.state == "ok" for s in fleet.slots):
+            time.sleep(0.02)
+        assert all(s.state == "ok" for s in fleet.slots)
+        assert fleet.n_respawns >= 1
+    finally:
+        fleet.shutdown()
+
+
+def test_shutdown_drains_inflight_before_exit():
+    """Coordinator shutdown waits for live requests before taking the
+    workers down: a submit immediately followed by shutdown still
+    delivers its answer."""
+    fleet = _fleet(token_delay_s=0.02, shutdown_drain_s=30)
+    ids = [1, 2, EVENT, 8]
+    fr = fleet.submit_ids(ids, _pv(3), 20)
+    fleet.shutdown()
+    assert fleet.result(fr, timeout=1) == _stub_chain(ids, 20)
+    assert all(s.proc is None for s in fleet.slots)
+
+
+def test_stream_delivers_at_finish_with_sentinel():
+    """Coordinator streams are deliver-at-finish: one cumulative token
+    list, then the engine stream protocol's None sentinel (which is
+    also why streamed requests can fail over here)."""
+    fleet = _fleet()
+    try:
+        ids = [1, 2, EVENT, 4]
+        fr = fleet.submit_ids(ids, _pv(2), 5, stream=True)
+        q = fleet.stream_queue(fr)
+        toks = q.get(timeout=60)
+        assert toks == _stub_chain(ids, 5)
+        assert q.get(timeout=10) is None
+    finally:
+        fleet.shutdown()

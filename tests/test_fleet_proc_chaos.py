@@ -1,0 +1,240 @@
+"""SIGKILL chaos for the process fleet (ISSUE 11): real
+``cli.serve --worker`` processes (tiny model, own jax runtime each)
+behind the ``ProcFleet`` coordinator. The acceptance script kills the
+busiest worker with SIGKILL mid-decode via the ``procfleet.worker_kill``
+site and asserts the redo failover's chains are byte-identical to a
+single-engine run, the journeys carry ``worker_lost``/``failover``/
+``respawn``, ``failover_redo_s`` > 0 with the exact phase-sum
+invariant, and the slot respawns back into the pool; the graceful
+drain path (``export_requests`` over RPC) is exercised on the same
+fleet. HTTP is validated over a real ``make_handler`` server — the
+process fleet serves it unchanged."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from eventgpt_tpu import faults
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
+from eventgpt_tpu.data.tokenizer import load_tokenizer
+from eventgpt_tpu.fleet_proc import ProcFleet
+from eventgpt_tpu.models import eventchat
+from eventgpt_tpu.obs import journey as obs_journey
+from eventgpt_tpu.serve import ContinuousBatcher
+
+WORKER_CMD = [sys.executable, "-m", "eventgpt_tpu.cli.serve", "--worker",
+              "--model_path", "tiny-random", "--dtype", "float32",
+              "--max_batch", "2", "--chunk", "2", "--max_len", "256"]
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.disable()
+    obs_journey.configure(512)
+    yield
+    faults.disable()
+    obs_journey.disable()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = EventChatConfig.tiny()
+    # float32, PRNGKey(0): the exact tree a worker's
+    # load_model("tiny-random", "float32") builds — the chain-identity
+    # reference must match the workers' weights bit-for-bit.
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _pv(cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(cfg.num_event_frames, 3,
+                            cfg.vision.image_size,
+                            cfg.vision.image_size)).astype(np.float32)
+
+
+def _ids(suffix=()):
+    return [1, 7, 7, EVENT_TOKEN_INDEX, 9, 10, 11] + list(suffix)
+
+
+def _reference_chains(tiny, reqs):
+    """Uninterrupted single-engine greedy chains for ``reqs`` — the
+    byte-identity bar every failover path must meet. The batcher
+    mirrors the worker flags (same weights, eos, temperature)."""
+    cfg, params = tiny
+    tok = load_tokenizer("byte")
+    b = ContinuousBatcher(params, cfg, max_batch=2, chunk=2, max_len=256,
+                          eos_token_id=tok.eos_token_id)
+    rids = [b.submit(ids, pv, n) for ids, pv, n in reqs]
+    done = b.run_until_drained()
+    return [done[r] for r in rids]
+
+
+def _fleet(**kw):
+    kw.setdefault("spawn_timeout_s", 300)
+    kw.setdefault("probe_interval_s", 0.03)
+    kw.setdefault("respawn_backoff_s", 0.05)
+    return ProcFleet(WORKER_CMD, 2, tokenizer=load_tokenizer("byte"),
+                     **kw)
+
+
+def _wait(cond, timeout_s, what):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_sigkill_chaos_redo_drain_respawn_byte_identical(tiny):
+    """THE acceptance script, both failover paths in sequence:
+
+    1. REDO: ``procfleet.worker_kill:n=1`` SIGKILLs the busiest worker
+       mid-decode; its requests are re-submitted from the
+       coordinator's records and finish byte-identical to the
+       single-engine reference, journeys carrying worker_lost +
+       failover(path=redo) + respawn and a positive failover_redo_s
+       that keeps the exact phase-sum invariant.
+    2. Recovery: the slot respawns (backoff) and re-enters the pool.
+    3. DRAIN: export_requests over RPC moves the busiest worker's
+       in-flight requests gracefully (path=drain), chains again
+       byte-identical.
+    """
+    cfg, _ = tiny
+    reqs = [(_ids((80 + i,)), _pv(cfg, 400 + i), 24) for i in range(4)]
+    ref = _reference_chains(tiny, reqs)
+
+    fleet = _fleet()
+    try:
+        # ---- redo path (SIGKILL) ----
+        frids = [fleet.submit_ids(ids, pv, n) for ids, pv, n in reqs]
+        _wait(lambda: any(s.snapshot.get("active_rows", 0) > 0
+                          for s in fleet.slots), 120, "a decoding worker")
+        faults.configure("procfleet.worker_kill:n=1")
+        _wait(lambda: fleet.n_deaths >= 1, 120, "the scripted SIGKILL")
+        assert faults.stats()["procfleet.worker_kill"]["fires"] == 1
+        out = [fleet.result(f, timeout=300) for f in frids]
+        assert out == ref, "redo failover diverged from the reference"
+        assert fleet.n_failovers >= 1
+        moved = [f for f in frids if fleet._requests[f].failovers >= 1]
+        assert moved, "no request failed over despite a worker death"
+        _wait(lambda: all((obs_journey.get(fleet._journey_owner, f)
+                           or {}).get("finished") for f in moved),
+              60, "journeys to close")
+        for f in moved:
+            j = fleet.journey(f)
+            assert j["finished"] and j["status"] == "ok"
+            kinds = [e["kind"] for e in j["events"]]
+            assert "worker_lost" in kinds and "failover" in kinds, kinds
+            ev = next(e for e in j["events"] if e["kind"] == "failover")
+            assert ev["path"] == "redo"
+            assert j["phases"]["failover_redo_s"] > 0.0
+            assert sum(j["phases"].values()) == pytest.approx(
+                j["e2e_s"], abs=1e-9)
+            legs = j["assignments"]
+            assert len(legs) >= 2, "failover must add an assignment"
+        # The respawn event lands on victims that were still live when
+        # the replacement spawned (tiny backoff => before they finish).
+        assert any("respawn" in [e["kind"] for e in
+                                 fleet.journey(f)["events"]]
+                   for f in moved), "no victim saw the respawn"
+
+        # ---- recovery ----
+        _wait(lambda: all(s.state == "ok" for s in fleet.slots), 300,
+              "the killed slot to respawn")
+        assert fleet.n_respawns >= 1
+
+        # ---- drain path (graceful) ----
+        # No snapshot wait here: a WARM worker finishes these in a few
+        # hundred ms, so the drain targets the busiest slot immediately
+        # after submit — it lands mid-queue or mid-decode, and the
+        # export must move whatever is unfinished either way.
+        frids2 = [fleet.submit_ids(ids, pv, n) for ids, pv, n in reqs]
+        busy = max(fleet.slots, key=lambda s: s.inflight)
+        moved_n = fleet.drain_worker(busy.idx)
+        out2 = [fleet.result(f, timeout=300) for f in frids2]
+        assert out2 == ref, "drain failover diverged from the reference"
+        if moved_n:  # in-flight work moved: the drain journey says so
+            f2 = next(f for f in frids2
+                      if fleet._requests[f].failovers >= 1)
+            ev = next(e for e in fleet.journey(f2)["events"]
+                      if e["kind"] == "failover")
+            assert ev["path"] == "drain"
+        assert fleet.n_kills >= 1
+    finally:
+        fleet.shutdown()
+        assert all(s.proc is None for s in fleet.slots)
+
+
+def test_proc_fleet_serves_http_unchanged(tiny, tmp_path):
+    """``make_handler`` serves a ProcFleet exactly like an engine:
+    POST /v1/generate round-trips through a worker process, /fleet
+    shows the process topology, /memory aggregates per-worker
+    ledgers, /stats answers."""
+    from http.server import ThreadingHTTPServer
+
+    from eventgpt_tpu.cli.serve import make_handler
+    from eventgpt_tpu.ops.raster import STREAM_DTYPE
+
+    cfg, _ = tiny
+    rng = np.random.default_rng(0)
+    n = 4000
+    arr = np.zeros(n, dtype=STREAM_DTYPE)
+    arr["x"] = rng.integers(0, 64, n)
+    arr["y"] = rng.integers(0, 48, n)
+    arr["t"] = np.sort(rng.integers(0, 50_000, n)).astype(np.uint64)
+    arr["p"] = rng.integers(0, 2, n)
+    path = os.path.join(str(tmp_path), "events.npy")
+    np.save(path, arr)
+    import base64
+
+    with open(path, "rb") as f:
+        b64 = base64.b64encode(f.read()).decode()
+
+    fleet = _fleet()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                make_handler(fleet, cfg))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            json.dumps({"query": "What is happening?", "event_b64": b64,
+                        "max_new_tokens": 6,
+                        "slo_class": "interactive"}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as r:
+            out = json.loads(r.read())
+        assert out["status"] == "ok" and out["tokens"] == 6
+        assert out["slo_class"] == "interactive"
+        with urllib.request.urlopen(url + "/fleet", timeout=60) as r:
+            fl = json.loads(r.read())
+        assert fl["proc_fleet"] is True and fl["workers"] == 2
+        assert fl["routable"] == 2
+        assert len(fl["per_worker"]) == 2
+        # Per-worker component bytes (each worker = its own process
+        # ledger): nonzero for every live worker.
+        assert all(w["memory_bytes"] > 0 for w in fl["per_worker"])
+        with urllib.request.urlopen(url + "/memory", timeout=60) as r:
+            mem = json.loads(r.read())
+        assert mem["proc_fleet"] is True
+        assert len(mem["workers"]) == 2
+        for w in mem["workers"]:
+            assert w["components"].get("weights", 0) > 0, w
+            assert w["components"].get("kv_cache", 0) > 0, w
+        with urllib.request.urlopen(url + "/stats", timeout=60) as r:
+            st = json.loads(r.read())
+        assert st["status"] == "ok" and st["requests"] >= 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        fleet.shutdown()
