@@ -235,7 +235,31 @@ def run_decode(args):
     _sync(ev)
     t_encode_compile = time.perf_counter() - t0
 
-    def measure(batch: int, kv: str, phase_box: dict = None):
+    def _to_paged_cache(cache, bs=64):
+        """Re-shape a prefilled dense cache into the paged block-pool
+        pytree (ISSUE 12): dense (L, B, S, ...) rows become B*S/bs pool
+        blocks behind row-major block tables (+ the reserved scratch
+        block 0). Pure reshape/concat — the VALUES are identical, so the
+        decode loop's paged chain is the dense chain and the measured
+        delta is exactly the block-table gather cost."""
+        def pool(buf):
+            if isinstance(buf, dict):
+                return {"q": pool(buf["q"]), "s": pool(buf["s"])}
+            l, b, s = buf.shape[:3]
+            blocks = buf.reshape((l, b * (s // bs), bs) + buf.shape[3:])
+            return jnp.concatenate(
+                [jnp.zeros_like(blocks[:, :1]), blocks], axis=1)
+
+        k_buf = cache["k"]["q"] if isinstance(cache["k"], dict) \
+            else cache["k"]
+        _, b, s = k_buf.shape[:3]
+        nbpr = s // bs
+        bt = 1 + jnp.arange(b * nbpr, dtype=jnp.int32).reshape(b, nbpr)
+        return {"k": pool(cache["k"]), "v": pool(cache["v"]), "bt": bt,
+                "length": cache["length"]}
+
+    def measure(batch: int, kv: str, phase_box: dict = None,
+                layout: str = "dense"):
         # ``phase_box`` (ISSUE 9): records which PHASE an OOM escapes
         # from — "compile" until the decode loop's first call (XLA
         # compile + first dispatch at the new shapes) has synced,
@@ -258,6 +282,8 @@ def run_decode(args):
                 cfg.llama, batch, cache_len, dtype, quant=kv == "int8"
             )
             last, cache = _prefill_jit(params, cfg, padded, mask, cache, True)
+            if layout == "paged":
+                cache = _to_paged_cache(cache)
             return last, cache
 
         t0 = time.perf_counter()
@@ -318,6 +344,7 @@ def run_decode(args):
 
         sweep, sweep_kv, sweep_retries = {}, {}, {}
         sweep_oom, sweep_est = {}, {}
+        sweep_paged, sweep_est_paged = {}, {}
         # Closed-form resident-bytes estimate per point (ISSUE 9): the
         # bytes-vs-batch curve PERFORMANCE.md "Batch scaling" needed —
         # weights + B dense rows at the leg's cache length, per KV
@@ -328,8 +355,15 @@ def run_decode(args):
         w_bytes = obs_memory.params_bytes(params)
         est_cache_len = ((prompt_len + args.decode_tokens + 64) // 64) * 64
 
-        def point_est_bytes(b, kv):
+        def point_est_bytes(b, kv, layout="dense"):
             pos = obs_memory.kv_pos_bytes(cfg, kv_quant=kv == "int8")
+            if layout == "paged":
+                # Block-pool closed form (ISSUE 12; mirrors
+                # obs_memory.estimate's kv_pool + kv_block_table terms):
+                # arena at this leg's USED tokens + scratch + tables.
+                nbpr = est_cache_len // 64
+                return (w_bytes + (b * nbpr + 1) * 64 * pos
+                        + b * nbpr * 4 + b * 4)
             return w_bytes + b * (est_cache_len * pos + 4)
 
         # Monotonicity only holds among the sweep's own bf16 points; the
@@ -382,9 +416,30 @@ def run_decode(args):
                     sweep_oom[str(b)]["int8"] = phase.get("phase",
                                                           "compile")
                     sweep_est[str(b)] = point_est_bytes(b, "int8")
+            # Paged twin (ISSUE 12): the same point through the block
+            # pool (dense prefill -> reshape into the arena -> block-
+            # table decode; values identical, so the tok/s delta IS the
+            # gather cost) with the block-pool closed form alongside —
+            # OOM recorded as data like every other leg. Where the
+            # dense attempt fell back to int8 KV, the paged twin pairs
+            # at that same storage.
+            kv_for = sweep_kv.get(str(b), "bf16")
+            phase = {}
+            try:
+                r, _, _ = measure(b, kv_for, phase, layout="paged")
+                sweep_paged[str(b)] = round(r, 2)
+            except Exception as e:
+                if not is_oom(e):
+                    raise
+                sweep_paged[str(b)] = "oom"
+                sweep_oom.setdefault(str(b), {})["paged"] = \
+                    phase.get("phase", "compile")
+            sweep_est_paged[str(b)] = point_est_bytes(b, kv_for, "paged")
         extras["batch_sweep_tok_s"] = sweep
         extras["batch_sweep_kv"] = sweep_kv
         extras["batch_sweep_est_bytes"] = sweep_est
+        extras["batch_sweep_tok_s_paged"] = sweep_paged
+        extras["batch_sweep_est_bytes_paged"] = sweep_est_paged
         if sweep_oom:
             extras["batch_sweep_oom"] = sweep_oom
         if sweep_retries:
@@ -530,6 +585,8 @@ def run_serve(args):
         prefix_cache=bool(args.serve_prefix_cache),
         prefix_insert=bool(args.serve_cache_insert),
         prefill_budget=int(args.serve_prefill_budget),
+        kv_layout=args.serve_kv_layout,
+        kv_pool_blocks=int(args.serve_kv_pool_blocks),
     )
     # Multi-session traffic (ISSUE 4): --serve_sessions S > 0 serves S
     # distinct event streams round-robin — the prefix cache's target
@@ -574,9 +631,10 @@ def run_serve(args):
             # Auto-populated cache: drop the warmup/priming entries so
             # the window that follows counts its cold misses honestly.
             # (Skipped when insert-on-prefill is off — there the
-            # operator-set entry IS the leg being measured.)
-            srv._prefix_cache = type(srv._prefix_cache)(
-                srv._prefix_cache.budget)
+            # operator-set entry IS the leg being measured.) Through
+            # the batcher's API: a hand-swapped cache would orphan a
+            # paged server's pinned block runs (ISSUE 12).
+            srv.reset_prefix_cache()
 
     if sessions and args.warmup:
         # Wave-executable priming (unmeasured): batcher.warmup() cannot
@@ -640,6 +698,10 @@ def run_serve(args):
     mem = obs_memory.LEDGER.summary()
     mem["reconcile"] = obs_memory.LEDGER.reconcile()
     mem["compiled"] = srv.compiled_footprint(probe=False)
+    if args.serve_kv_layout == "paged":
+        # Block-pool pressure over the measured window (ISSUE 12):
+        # used/free blocks, COW copies, gate deferrals.
+        mem["kv_blocks"] = srv.memory_summary().get("kv_blocks")
     record = {
         "metric": f"serve_aggregate_{preset}",
         "value": round(tot / dt, 2),
@@ -648,6 +710,7 @@ def run_serve(args):
         "tokens": tot,
         "max_batch": srv.max_batch,
         "chunk": args.serve_chunk,
+        "kv_layout": args.serve_kv_layout,
         "decode_tokens": args.decode_tokens,
         "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 3),
         "ttft_p99_s": round(float(np.percentile(ttfts, 99)), 3),
@@ -834,6 +897,8 @@ def run_workload(args):
         prefix_cache=bool(args.serve_prefix_cache),
         prefix_insert=bool(args.serve_cache_insert),
         prefill_budget=int(args.serve_prefill_budget),
+        kv_layout=args.serve_kv_layout,
+        kv_pool_blocks=int(args.serve_kv_pool_blocks),
     )
     shape = (cfg.num_event_frames, 3, cfg.vision.image_size,
              cfg.vision.image_size)
@@ -850,8 +915,9 @@ def run_workload(args):
     def fresh_cache():
         if (srv._prefix_cache is not None
                 and bool(args.serve_cache_insert)):
-            srv._prefix_cache = type(srv._prefix_cache)(
-                srv._prefix_cache.budget)
+            # Batcher API, not a hand swap: paged entries pin pool
+            # blocks that must release with the entries (ISSUE 12).
+            srv.reset_prefix_cache()
 
     plens = sorted({wl.cache_positions(r, cfg.num_event_tokens)
                     for r in trace})
@@ -936,6 +1002,9 @@ def run_workload(args):
                 "reconcile": obs_memory.LEDGER.reconcile(),
             },
         }
+        if args.serve_kv_layout == "paged":
+            # Block-pool pressure per sweep point (ISSUE 12).
+            leg["kv_blocks"] = srv.memory_summary().get("kv_blocks")
         leg.update(leg_extra)
         if args.serve_prefix_cache:
             leg["prefix_cache_hit_ratio"] = round(
@@ -1053,6 +1122,7 @@ def run_workload(args):
         },
         "max_batch": srv.max_batch,
         "chunk": args.serve_chunk,
+        "kv_layout": args.serve_kv_layout,
         "prefill_budget": int(args.serve_prefill_budget),
         "pipeline": bool(args.serve_pipeline),
         "prefix_cache": bool(args.serve_prefix_cache),
@@ -1175,7 +1245,7 @@ def _run_workload_fleet(args, preset, cfg, platform, params, spec, trace):
         for b in batchers:
             b.reset_serving_stats()
             if b._prefix_cache is not None and bool(args.serve_cache_insert):
-                b._prefix_cache = type(b._prefix_cache)(b._prefix_cache.budget)
+                b.reset_prefix_cache()
         obs_metrics.REGISTRY.reset()
         obs_memory.LEDGER.reset_peak()  # per-point peak (ISSUE 9)
 
@@ -2352,6 +2422,16 @@ def main() -> None:
                         "(segment N+1 dispatched from device-resident "
                         "state while the host harvests N); 0 = the "
                         "synchronous escape hatch, for A/B runs")
+    p.add_argument("--serve_kv_layout", default="dense",
+                   choices=["dense", "paged"],
+                   help="mode=serve/workload: resident KV layout "
+                        "(ISSUE 12). 'paged' = SEQ_BUCKET block pool + "
+                        "per-row block tables, admission gated by free "
+                        "blocks; records carry kv_layout so "
+                        "compare_bench pairs layouts honestly")
+    p.add_argument("--serve_kv_pool_blocks", type=int, default=0,
+                   help="paged pool size in blocks incl. scratch "
+                        "(0 = dense-equivalent capacity)")
     p.add_argument("--preset", default="auto", choices=["auto", "7b", "13b", "tiny"])
     # Reference run shape: inference.py:19 max_new_tokens=512.
     p.add_argument("--decode_tokens", type=int, default=512)

@@ -1365,6 +1365,10 @@ def build_engine(args, force_single: bool = False):
                 getattr(args, "mem_headroom_mb", 0.0) * 1024 * 1024),
             mem_capacity_bytes=int(
                 getattr(args, "mem_capacity_mb", 0.0) * 1024 * 1024),
+            # Paged KV block pool (ISSUE 12): block-granular allocation
+            # + used-token admission; "dense" is the A/B escape hatch.
+            kv_layout=getattr(args, "kv_layout", "dense"),
+            kv_pool_blocks=int(getattr(args, "kv_pool_blocks", 0)),
         )
 
     def _make_engine(batcher, hb_dir):
@@ -1490,6 +1494,21 @@ def main(argv=None):
                    help="fuse qkv / gate-up before quantization (+4%% at "
                         "wide batches, neutral at batch 1 — PERFORMANCE.md)")
     p.add_argument("--kv_cache", default="bf16", choices=["bf16", "int8"])
+    p.add_argument("--kv_layout", default="dense",
+                   choices=["dense", "paged"],
+                   help="resident KV layout (ISSUE 12): 'paged' replaces "
+                        "the dense (batch, max_len) cache with one "
+                        "SEQ_BUCKET-block pool + per-row block tables — "
+                        "admission gated by free blocks (used tokens), "
+                        "prefix hits alias block runs with copy-on-"
+                        "write. Chains are byte-identical to 'dense' "
+                        "(the A/B escape hatch)")
+    p.add_argument("--kv_pool_blocks", type=int, default=0,
+                   help="paged pool size in blocks incl. the scratch "
+                        "block (0 = dense-equivalent capacity: "
+                        "max_batch * max_len/SEQ_BUCKET + 1). Size it by "
+                        "expected USED tokens, not worst case — "
+                        "GET /memory's kv_blocks shows live pressure")
     p.add_argument("--speculative", type=int, default=0)
     p.add_argument("--draft_head", default=None,
                    help="trained Medusa head stack (.npz) for speculative "

@@ -265,17 +265,84 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.bfloat16
     }
 
 
+def init_paged_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,
+                        n_blocks: int, block_size: int,
+                        dtype=jnp.bfloat16, quant: bool = False) -> KVCache:
+    """Paged KV cache (ISSUE 12): ONE static block-pool arena per plane —
+    (L, n_blocks, block_size, KV, hd) — plus a per-row int32 block table
+    ``bt`` (batch, max_len // block_size). Rows no longer own dense
+    ``max_len`` runs: logical position ``p`` of row ``r`` lives at pool
+    slot ``(bt[r, p // bs], p % bs)``, so resident bytes scale with the
+    blocks actually reserved, not ``batch × max_len``. Every shape stays
+    static for XLA; the dynamic part (which block backs which row) is
+    host bookkeeping (``serve_blocks.BlockPool``). Tables start at block
+    0 — the pool's reserved scratch block — so an unadmitted row's
+    unconditional frozen writes land in storage nothing reads."""
+    if max_len % block_size:
+        raise ValueError(
+            f"max_len {max_len} must be a block_size {block_size} multiple")
+    hd = cfg.resolved_head_dim()
+    shape = (cfg.num_layers, n_blocks, block_size, cfg.num_kv_heads, hd)
+    if quant:
+        def qbuf():
+            return {"q": jnp.zeros(shape, jnp.int8),
+                    "s": jnp.zeros(shape[:-1] + (1,), jnp.float32)}
+
+        k, v = qbuf(), qbuf()
+    else:
+        k, v = jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+    return {
+        "k": k,
+        "v": v,
+        "bt": jnp.zeros((batch, max_len // block_size), jnp.int32),
+        "length": jnp.zeros((batch,), jnp.int32),
+    }
+
+
 def _kv_is_quant(cache: KVCache) -> bool:
     return isinstance(cache["k"], dict)
 
 
-def _cache_write(buf, li, batch_idx, slots, vals, quant: bool):
+def _kv_is_paged(cache: KVCache) -> bool:
+    return "bt" in cache
+
+
+def _kv_max_len(cache: KVCache) -> int:
+    """Logical per-row KV capacity: dense reads it off the buffer's slot
+    axis; paged, off the block table (rows × blocks-per-row view)."""
+    buf = cache["k"]["q"] if _kv_is_quant(cache) else cache["k"]
+    if _kv_is_paged(cache):
+        return cache["bt"].shape[1] * buf.shape[2]
+    return buf.shape[2]
+
+
+def _cache_write(buf, li, batch_idx, slots, vals, quant: bool, bt=None):
     """Write new K/V rows into layer ``li`` of a cache buffer — THE cache
     write for both decode paths, so the bf16-vs-int8 handling cannot drift
     between them. ``slots`` (B,) writes one slot per row (decode_step's hot
     loop — lowers to an in-place dynamic-update-slice); (B, K) writes a
     verification window per row (decode_kstep — a scatter). ``vals`` has a
-    matching leading shape + (KV, hd)."""
+    matching leading shape + (KV, hd).
+
+    ``bt`` (paged cache): logical slots translate through the row's block
+    table to (pool block, offset) pairs. Values written are identical to
+    the dense path's — the translation is pure indexing — which is what
+    keeps paged chains byte-identical to dense ones. Writable blocks are
+    exclusively owned by construction (copy-on-write in the serving
+    allocator), so the scatter indices of live rows never collide; frozen
+    rows' garbage writes all land in the shared scratch block, whose
+    content no attention read ever sees (masked above ``length``)."""
+    if bt is not None:
+        bs = (buf["q"] if quant else buf).shape[2]
+        blk = slots // bs
+        off = slots % bs
+        blocks = (bt[batch_idx, blk] if slots.ndim == 1
+                  else bt[batch_idx[:, None], blk])
+        if quant:
+            qs = _kv_quantize(vals)
+            return {"q": buf["q"].at[li, blocks, off].set(qs["q"]),
+                    "s": buf["s"].at[li, blocks, off].set(qs["s"])}
+        return buf.at[li, blocks, off].set(vals.astype(buf.dtype))
     idx = batch_idx if slots.ndim == 1 else batch_idx[:, None]
     if quant:
         qs = _kv_quantize(vals)
@@ -284,10 +351,31 @@ def _cache_write(buf, li, batch_idx, slots, vals, quant: bool):
     return buf.at[li, idx, slots].set(vals.astype(buf.dtype))
 
 
-def _cache_read_layer(buf, li, dtype, quant: bool):
+def _cache_read_layer(buf, li, dtype, quant: bool, bt=None):
     """Layer ``li`` of a cache buffer as (B, S, KV, hd) in ``dtype``. For the
     int8 cache the dequant fuses into the attention einsum's operand reads:
-    HBM streams int8 payloads + 1/hd scales instead of bf16."""
+    HBM streams int8 payloads + 1/hd scales instead of bf16.
+
+    ``bt`` (paged cache): the pure-jnp gather fallback — pool blocks
+    gather through the block table into the same (B, S, KV, hd) view the
+    dense path reads (S = blocks_per_row × block_size), so the attention
+    math downstream is untouched and bitwise identical (a gather is a
+    copy). The view is a per-layer TEMPORARY — 1/L of the dense cache's
+    residency — not a resident buffer; the paged Pallas kernel
+    (``ops/decode_attention.decode_attention_int8_paged``) computes
+    attention block-by-block without materializing it at all, and is the
+    TPU wiring for this seam."""
+    if bt is not None:
+        b, nbpr = bt.shape
+        if quant:
+            lq = lax.dynamic_index_in_dim(buf["q"], li, keepdims=False)[bt]
+            ls = lax.dynamic_index_in_dim(buf["s"], li, keepdims=False)[bt]
+            x = _kv_dequant({"q": lq, "s": ls}, dtype)
+        else:
+            x = lax.dynamic_index_in_dim(buf, li, keepdims=False)[bt]
+            x = x.astype(dtype)
+        # (B, nbpr, bs, KV, hd) -> (B, nbpr * bs, KV, hd)
+        return x.reshape(b, nbpr * x.shape[2], x.shape[3], x.shape[4])
     if quant:
         leaf = {"q": lax.dynamic_index_in_dim(buf["q"], li, keepdims=False),
                 "s": lax.dynamic_index_in_dim(buf["s"], li, keepdims=False)}
@@ -337,6 +425,14 @@ def prefill(
     context size). T must divide the context axis size. Both fall back to
     dense on a context-1 mesh.
     """
+    if _kv_is_paged(cache):
+        # Serving never prefills into the pool directly: admission
+        # prefills a dense per-request row cache and SCATTERS it into
+        # allocated blocks (serve._admit_row_paged) — the seam that
+        # keeps one prefill executable per bucket, pool-size-agnostic.
+        raise ValueError(
+            "prefill writes dense caches; scatter into a paged pool via "
+            "the serving admission path")
     b, t, d = inputs_embeds.shape
     positions = jnp.cumsum(attention_mask.astype(jnp.int32), axis=1) - 1
     positions = jnp.maximum(positions, 0)
@@ -434,8 +530,7 @@ def decode_step(
     the number of real tokens so far (right-pad-free positions).
     """
     b = token_embeds.shape[0]
-    k_buf = cache["k"]["q"] if _kv_is_quant(cache) else cache["k"]
-    max_len = k_buf.shape[2]
+    max_len = _kv_max_len(cache)
     pos = cache["length"]  # (B,)
     cos, sin = rope_tables(cfg, pos[:, None])
 
@@ -445,6 +540,7 @@ def decode_step(
 
     batch_idx = jnp.arange(b)
     quant = _kv_is_quant(cache)
+    bt = cache.get("bt")  # paged: logical->pool block translation
 
     # The cache rides the scan as CARRY (not xs/ys): XLA aliases carry
     # buffers across iterations, so the (B,)-slot _cache_write lowers to an
@@ -458,11 +554,15 @@ def decode_step(
         y = rms_norm(h_in, layer["input_norm"], cfg.rms_norm_eps)
         q_proj, k_new, v_new = _project_qkv(cfg, y, layer)
         k_new = apply_rope(k_new, cos, sin)
-        k_buf = _cache_write(k_buf, li, batch_idx, slot, k_new[:, 0], quant)
-        v_buf = _cache_write(v_buf, li, batch_idx, slot, v_new[:, 0], quant)
+        k_buf = _cache_write(k_buf, li, batch_idx, slot, k_new[:, 0], quant,
+                             bt=bt)
+        v_buf = _cache_write(v_buf, li, batch_idx, slot, v_new[:, 0], quant,
+                             bt=bt)
         h_mid = h_in + _attn_block(cfg, q_proj, layer, cos, sin,
-                                   _cache_read_layer(k_buf, li, h_in.dtype, quant),
-                                   _cache_read_layer(v_buf, li, h_in.dtype, quant),
+                                   _cache_read_layer(k_buf, li, h_in.dtype,
+                                                     quant, bt=bt),
+                                   _cache_read_layer(v_buf, li, h_in.dtype,
+                                                     quant, bt=bt),
                                    mask)
         y2 = rms_norm(h_mid, layer["post_norm"], cfg.rms_norm_eps)
         h_out = h_mid + _mlp_block(y2, layer)
@@ -473,6 +573,8 @@ def decode_step(
         (params["layers"], jnp.arange(cfg.num_layers)),
     )
     new_cache = {"k": k_all, "v": v_all, "length": cache["length"] + 1}
+    if bt is not None:
+        new_cache["bt"] = bt
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _mm_f32(x[:, 0], params["lm_head"])
     return logits, new_cache
@@ -503,8 +605,7 @@ def decode_kstep(
     tokens costs ~one token's wall time at batch 1.
     """
     b, kq, _ = token_embeds.shape
-    k_buf0 = cache["k"]["q"] if _kv_is_quant(cache) else cache["k"]
-    max_len = k_buf0.shape[2]
+    max_len = _kv_max_len(cache)
     base = cache["length"]  # (B,) tokens already cached
     offs = jnp.arange(kq)
     pos = base[:, None] + offs[None, :]  # (B, K) global positions
@@ -516,6 +617,7 @@ def decode_kstep(
 
     batch_idx = jnp.arange(b)
     quant = _kv_is_quant(cache)
+    bt = cache.get("bt")  # paged: logical->pool block translation
 
     def block(carry, xs):
         h_in, k_buf, v_buf = carry
@@ -523,11 +625,13 @@ def decode_kstep(
         y = rms_norm(h_in, layer["input_norm"], cfg.rms_norm_eps)
         q_proj, k_new, v_new = _project_qkv(cfg, y, layer)
         k_new = apply_rope(k_new, cos, sin)
-        k_buf = _cache_write(k_buf, li, batch_idx, pos, k_new, quant)
-        v_buf = _cache_write(v_buf, li, batch_idx, pos, v_new, quant)
+        k_buf = _cache_write(k_buf, li, batch_idx, pos, k_new, quant, bt=bt)
+        v_buf = _cache_write(v_buf, li, batch_idx, pos, v_new, quant, bt=bt)
         h_mid = h_in + _attn_block(cfg, q_proj, layer, cos, sin,
-                                   _cache_read_layer(k_buf, li, h_in.dtype, quant),
-                                   _cache_read_layer(v_buf, li, h_in.dtype, quant),
+                                   _cache_read_layer(k_buf, li, h_in.dtype,
+                                                     quant, bt=bt),
+                                   _cache_read_layer(v_buf, li, h_in.dtype,
+                                                     quant, bt=bt),
                                    mask)
         y2 = rms_norm(h_mid, layer["post_norm"], cfg.rms_norm_eps)
         h_out = h_mid + _mlp_block(y2, layer)
@@ -538,6 +642,8 @@ def decode_kstep(
         (params["layers"], jnp.arange(cfg.num_layers)),
     )
     new_cache = {"k": k_all, "v": v_all, "length": cache["length"] + kq}
+    if bt is not None:
+        new_cache["bt"] = bt
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _mm_f32(x, params["lm_head"])  # (B, K, V)
     if return_hidden:

@@ -57,6 +57,10 @@ from typing import Any, Dict, List, Optional, Tuple
 #   queue           request LEFT the queue (queue wait ends here)
 #   prefix          prefix-cache decision (hit + matched length, or miss)
 #   mem_guard_defer the headroom guard deferred this request's boundary
+#   kv_block_defer  the paged pool's used-token gate deferred it (the
+#                   queue head's block reservation did not fit the free
+#                   list; ISSUE 12) — counts into defer_s like the
+#                   byte-headroom deferral
 #   lane_join       admission became a piggyback prefill lane
 #   lane_finish     the lane covered its prompt (activation follows)
 #   admit           row activated into the shared cache
@@ -75,10 +79,10 @@ from typing import Any, Dict, List, Optional, Tuple
 #   exported        the replica drained it for re-admission elsewhere
 #   finish          terminal bookkeeping (status + slo_met)
 EVENT_KINDS = (
-    "submit", "queue", "prefix", "mem_guard_defer", "lane_join",
-    "lane_finish", "admit", "segment", "shed", "route", "repin",
-    "failover", "worker_lost", "respawn", "nan_quarantine", "deadline",
-    "cancel", "exported", "finish",
+    "submit", "queue", "prefix", "mem_guard_defer", "kv_block_defer",
+    "lane_join", "lane_finish", "admit", "segment", "shed", "route",
+    "repin", "failover", "worker_lost", "respawn", "nan_quarantine",
+    "deadline", "cancel", "exported", "finish",
 )
 
 # The CLOSED dominant-miss-cause enum. It is the ``cause`` label of
@@ -266,7 +270,8 @@ class JourneyRecorder:
                 rec["t_last_commit"] = t
                 rec["segments"] += 1
                 rec["tokens"] += int(fields.get("tokens", 0))
-            elif kind == "mem_guard_defer" and rec["t_defer"] is None:
+            elif (kind in ("mem_guard_defer", "kv_block_defer")
+                    and rec["t_defer"] is None):
                 rec["t_defer"] = t
 
     def finish(self, owner: int, rid: int, status: str,

@@ -58,8 +58,8 @@ from eventgpt_tpu.obs import trace as obs_trace
 # The component taxonomy (OBSERVABILITY.md "Memory ledger"). A CLOSED
 # set on purpose: component names become the egpt_mem_component_bytes
 # label values (METRIC_LABELS enum, lint rule 5 — bounded cardinality).
-COMPONENTS = ("weights", "kv_cache", "logits", "ids_buf", "prefix_cache",
-              "lanes", "draft", "carry", "other")
+COMPONENTS = ("weights", "kv_cache", "kv_pool", "kv_block_table", "logits",
+              "ids_buf", "prefix_cache", "lanes", "draft", "carry", "other")
 
 
 class MemoryLedger:
@@ -315,7 +315,9 @@ def estimate(cfg, *, max_batch: int, max_len: int, kv_quant: bool = False,
              lane_bucket: Optional[int] = None,
              prefix_cache_bytes: int = 0, weights_bytes: int = 0,
              vocab: Optional[int] = None,
-             mesh_shape: Optional[Dict[str, int]] = None) -> Dict[str, Any]:
+             mesh_shape: Optional[Dict[str, int]] = None,
+             kv_layout: str = "dense", kv_pool_blocks: int = 0,
+             kv_block_size: int = 0) -> Dict[str, Any]:
     """Static capacity model: closed-form component bytes for one
     ``ContinuousBatcher`` from its config — what the server WILL hold
     resident, before it is ever built. Mirrors the constructor's own
@@ -340,8 +342,21 @@ def estimate(cfg, *, max_batch: int, max_len: int, kv_quant: bool = False,
     comp: Dict[str, int] = {}
     if weights_bytes:
         comp["weights"] = int(weights_bytes)
-    # Resident decode cache: B rows + the (B,) int32 length plane.
-    comp["kv_cache"] = max_batch * row_bytes + max_batch * 4
+    if kv_layout == "paged":
+        # Paged layout (ISSUE 12): one block-pool arena — n_blocks
+        # blocks of block_size positions per layer/plane, SCRATCH block
+        # included — plus the per-row int32 block tables and the (B,)
+        # length plane. Mirrors serve's constructor arithmetic exactly
+        # (default pool = dense-equivalent capacity + 1 scratch) so the
+        # ledger test can hold it byte-exact against the live arena.
+        bs = int(kv_block_size) or SEQ_BUCKET
+        nbpr = max_len // bs
+        n_blocks = int(kv_pool_blocks) or (max_batch * nbpr + 1)
+        comp["kv_pool"] = n_blocks * bs * pos_bytes
+        comp["kv_block_table"] = max_batch * nbpr * 4 + max_batch * 4
+    else:
+        # Resident decode cache: B rows + the (B,) int32 length plane.
+        comp["kv_cache"] = max_batch * row_bytes + max_batch * 4
     # Per-row next-token logits carry (f32 by construction).
     comp["logits"] = max_batch * vocab * 4
     if speculative:
@@ -386,7 +401,12 @@ def estimate(cfg, *, max_batch: int, max_len: int, kv_quant: bool = False,
                 # Batch over (data, fsdp) AND kv-heads over model
                 # compose multiplicatively (shard_kv_cache's spec).
                 per[name] = n // (div["batch"] * div["kv_heads"])
-            elif name in ("logits", "ids_buf", "draft"):
+            elif name == "kv_pool":
+                # The arena has no batch axis: blocks replicate over
+                # the batch axes (any row may read any block), only the
+                # KV-head axis shards (shard_kv_cache's paged branch).
+                per[name] = n // div["kv_heads"]
+            elif name in ("kv_block_table", "logits", "ids_buf", "draft"):
                 per[name] = n // div["batch"]
             else:
                 per[name] = n // div["kv_heads"] if name == "prefix_cache" \

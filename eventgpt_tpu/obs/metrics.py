@@ -82,8 +82,12 @@ METRIC_LABELS = {
         # The memory ledger's component taxonomy (obs/memory.py
         # COMPONENTS — keep the two literals identical; the ledger
         # validates at register time, this enum at observe time).
-        "component": ("weights", "kv_cache", "logits", "ids_buf",
-                      "prefix_cache", "lanes", "draft", "carry", "other"),
+        # kv_pool / kv_block_table are the paged-layout split of
+        # kv_cache (ISSUE 12): the arena scales with blocks, the table
+        # with max_batch.
+        "component": ("weights", "kv_cache", "kv_pool", "kv_block_table",
+                      "logits", "ids_buf", "prefix_cache", "lanes",
+                      "draft", "carry", "other"),
     },
     "egpt_fleet_routed_total": {
         # Routing decisions (ISSUE 7): affinity = the session's pinned
@@ -663,8 +667,9 @@ PROCFLEET_CRASH_LOOPS = REGISTRY.counter(
 MEM_COMPONENT = REGISTRY.gauge(
     "egpt_mem_component_bytes",
     "Device bytes the memory ledger attributes to each named component "
-    "(weights / kv_cache / logits / ids_buf / prefix_cache / lanes / "
-    "draft / carry / other)")
+    "(weights / kv_cache / kv_pool / kv_block_table / logits / ids_buf "
+    "/ prefix_cache / lanes / draft / carry / other; kv_pool + "
+    "kv_block_table are the paged layout's split of kv_cache)")
 MEM_TOTAL = REGISTRY.gauge(
     "egpt_mem_total_bytes",
     "Sum of all ledger-registered device bytes (the accounted side of "
@@ -685,6 +690,30 @@ MEM_GUARD_DEFERRALS = REGISTRY.counter(
     "egpt_mem_guard_deferrals_total",
     "Admission waves deferred by the --mem_headroom_mb guard (the "
     "ledger predicted the next wave would exceed capacity - headroom)")
+
+# -- paged KV block pool (ISSUE 12, eventgpt_tpu/serve_blocks.py) --
+SERVE_KV_BLOCKS_USED = REGISTRY.gauge(
+    "egpt_serve_kv_blocks_used",
+    "Pool blocks currently owned by rows and prefix entries (used "
+    "tokens at the SEQ_BUCKET block grain — the quantity that now "
+    "gates admission instead of batch x max_len)")
+SERVE_KV_BLOCKS_FREE = REGISTRY.gauge(
+    "egpt_serve_kv_blocks_free",
+    "Pool blocks on the free list (admission headroom in blocks)")
+SERVE_KV_COW_COPIES = REGISTRY.counter(
+    "egpt_serve_kv_cow_copies_total",
+    "Copy-on-write block copies: a prefix-shared run diverged mid-"
+    "block and the admission scatter re-created the boundary block in "
+    "the row's private reservation")
+SERVE_KV_ALLOC_FAILURES = REGISTRY.counter(
+    "egpt_serve_kv_alloc_failures_total",
+    "Block allocations the pool could not cover (each one defers an "
+    "admission or refuses a prefix insert; never a partial grant)")
+SERVE_KV_BLOCK_DEFERRALS = REGISTRY.counter(
+    "egpt_serve_kv_block_deferrals_total",
+    "Admissions deferred by the used-token block gate (the queue head's "
+    "whole reservation did not fit the free list, even after "
+    "reclaiming unpinned prefix entries)")
 MEM_COMPILED_TEMP = REGISTRY.gauge(
     "egpt_mem_compiled_temp_bytes",
     "XLA temp allocation of the probed decode/spec segment executable "

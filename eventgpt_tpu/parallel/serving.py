@@ -227,8 +227,40 @@ def prefix_block_sharding(mesh: Mesh, cfg) -> NamedSharding:
 def shard_kv_cache(cache: Any, cfg, mesh: Mesh) -> Any:
     """Place a fresh KV cache: (L, B, S, KV, hd) with batch over the serving
     batch axes and KV heads over ``model`` (skipped if it does not divide
-    the head count). ``length`` (B,) shards with the batch."""
+    the head count). ``length`` (B,) shards with the batch.
+
+    Paged caches (ISSUE 12, ``"bt"`` present): the arena has NO batch
+    axis — which row owns which block is host bookkeeping, so any device
+    may need any block — and therefore replicates over the batch axes;
+    only the KV-head axis shards over ``model`` (the same per-device
+    divisor as the dense cache's head split). The block table and length
+    planes shard with the batch like every per-row carry. This trades
+    the dense layout's batch-axis KV split for block-granular
+    allocation; recovering a sharded arena (blocks over (data, fsdp)
+    with placement-aware tables) is the item-1b handoff seam
+    (DISTRIBUTED.md)."""
     quant = isinstance(cache["k"], dict)
+    if "bt" in cache:
+        batch = int(cache["bt"].shape[0])
+        baxes = serving_batch_axes(mesh, batch)
+        bspec = baxes if baxes else None
+        model_n = mesh.shape.get("model", 1)
+        head_ax = ("model" if (model_n > 1
+                               and cfg.num_kv_heads % model_n == 0) else None)
+        pool_spec = P(None, None, None, head_ax, None)
+
+        def put_pool(buf):
+            if isinstance(buf, dict):
+                return {"q": _put(buf["q"], mesh, pool_spec),
+                        "s": _put(buf["s"], mesh, pool_spec)}
+            return _put(buf, mesh, pool_spec)
+
+        return {
+            "k": put_pool(cache["k"]),
+            "v": put_pool(cache["v"]),
+            "bt": _put(cache["bt"], mesh, P(bspec, None)),
+            "length": _put(cache["length"], mesh, P(bspec)),
+        }
     batch = int(
         (cache["k"]["q"] if quant else cache["k"]).shape[1]
     )

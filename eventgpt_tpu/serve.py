@@ -100,6 +100,7 @@ import numpy as np
 from jax import lax
 
 from eventgpt_tpu import faults
+from eventgpt_tpu import serve_blocks
 from eventgpt_tpu.config import EventChatConfig
 from eventgpt_tpu.obs import journey as obs_journey
 from eventgpt_tpu.obs import memory as obs_memory
@@ -157,13 +158,25 @@ class _PrefixEntry:
     ids: tuple
     pixels_key: Optional[bytes]
     has_event: bool
-    kv: Dict[str, Any]
+    kv: Optional[Dict[str, Any]]
     length: int          # real cache positions the entry covers
     bucket: int          # stored block length (serving bucket grain)
     nbytes: int
     pins: int = 0        # rows currently decoding that admitted from this
     tick: int = 0        # LRU clock at last insert/hit
     hits: int = 0
+    # Paged layout (ISSUE 12): the entry IS a pinned run of pool blocks
+    # (``kv`` is None) — "copy" on a hit is block-table aliasing with a
+    # refcount, eviction is a ``BlockPool.decref``, and the dense
+    # (L, 1, bucket) view the exclusive suffix/lane paths read is
+    # gathered on demand (``ContinuousBatcher._entry_kv``).
+    blocks: Optional[List[int]] = None
+    # Detached (evicted/replaced) while pinned: a DENSE entry's arrays
+    # stay alive through plain object references, but a paged entry's
+    # storage is pool blocks — releasing them under a pinned entry
+    # would hand a still-needed prefix to the next admission. The
+    # release defers to the LAST pin drain (``_drain_entry_pin``).
+    detached: bool = False
 
 
 class PrefixCache:
@@ -217,6 +230,11 @@ class PrefixCache:
         import threading
 
         self.budget = int(budget_bytes)
+        # Paged servers attach their BlockPool here (immutable after
+        # construction, like ``budget``): dropping an entry then also
+        # decrefs its pinned block run. Lock order: PrefixCache._lock ->
+        # BlockPool._lock (leafward, like the ledger/metric locks).
+        self.pool = None
         self._root: Dict[str, Any] = {"c": {}, "e": {}}
         self._lock = threading.Lock()
         self.bytes = 0
@@ -321,9 +339,12 @@ class PrefixCache:
             if old is not None:
                 # Replacement detaches the old entry object; any pins on
                 # it drain harmlessly there, and its KV stays alive via
-                # the in-flight rows' references until they finish.
+                # the in-flight rows' references until they finish. A
+                # paged entry's block run drops ITS refcount only — rows
+                # aliasing those blocks keep their own refs.
                 self.bytes -= old.nbytes
                 self.n_entries -= 1
+                self._release_blocks_locked(old)
             self._tick += 1
             entry.tick = self._tick
             node["e"][entry.pixels_key] = entry
@@ -363,7 +384,53 @@ class PrefixCache:
             self.bytes -= victim.nbytes
             self.n_entries -= 1
             self.evictions += 1
+            self._release_blocks_locked(victim)
             obs_metrics.SERVE_PREFIX_EVICTIONS.inc()
+
+    def _release_blocks_locked(self, entry: _PrefixEntry) -> None:
+        """Drop a detached paged entry's block refs (its share only —
+        aliasing rows hold their own). A PINNED entry (selected for an
+        in-flight admission, seeding a pending lane, or backing active
+        rows) defers the release to its last pin drain — the paged twin
+        of the dense detached-object rule."""
+        if entry.blocks and self.pool is not None:
+            if entry.pins > 0:
+                entry.detached = True
+                return
+            self.pool.decref(entry.blocks)
+            entry.blocks = None
+
+    def reclaim_blocks(self, pool, need: int) -> int:
+        """Block-pressure eviction (ISSUE 12): evict LRU UNPINNED entries
+        until ``pool`` has ``need`` free blocks or nothing evictable is
+        left — the paged admission gate's reclaim path, which unifies
+        prefix-entry eviction with row allocation (an idle entry's
+        pinned run is the only reclaimable pool capacity). Returns the
+        number of entries evicted."""
+        evicted = 0
+        with self._lock:
+            while pool.free_blocks() < need:
+                victim_node, victim_key, victim = None, None, None
+                for node in self._iter_nodes_locked():
+                    for key, e in node["e"].items():
+                        if e.pins > 0 or not e.blocks:
+                            continue
+                        if victim is None or e.tick < victim.tick:
+                            victim_node, victim_key, victim = node, key, e
+                if victim is None:
+                    break
+                del victim_node["e"][victim_key]
+                self.bytes -= victim.nbytes
+                self.n_entries -= 1
+                self.evictions += 1
+                evicted += 1
+                self._release_blocks_locked(victim)
+                obs_metrics.SERVE_PREFIX_EVICTIONS.inc()
+            if evicted:
+                self._export_gauges_locked()
+                obs_memory.LEDGER.resize("prefix_cache", self._mem_key,
+                                         self.bytes)
+        return evicted
 
     def _export_gauges_locked(self) -> None:
         obs_metrics.SERVE_PREFIX_BYTES.set(self.bytes)
@@ -378,6 +445,22 @@ class PrefixCache:
             obs_memory.LEDGER.release("prefix_cache", self._mem_key)
         except Exception:
             pass
+
+    def clear(self) -> None:
+        """Drop every entry (the bench's per-leg reset): paged entries
+        release their block runs through the same deferred-on-pins rule
+        as eviction, the trie/bytes reset, counters KEEP counting (a
+        fresh-counter reset is ``ContinuousBatcher.reset_prefix_cache``,
+        which swaps in a new cache)."""
+        with self._lock:
+            for node in self._iter_nodes_locked():
+                for e in node["e"].values():
+                    self._release_blocks_locked(e)
+            self._root = {"c": {}, "e": {}}
+            self.bytes = 0
+            self.n_entries = 0
+            self._export_gauges_locked()
+            obs_memory.LEDGER.resize("prefix_cache", self._mem_key, 0)
 
     def stats(self) -> Dict[str, Any]:
         """Snapshot for ``GET /prefix_cache`` (lock-held, host-only)."""
@@ -657,6 +740,106 @@ def _admit_wave(cache, logits_buf, rows, wave_k, wave_v, wave_len,
 _admit_wave_jit = functools.partial(
     jax.jit, donate_argnames=("cache", "logits_buf")
 )(_admit_wave)
+
+
+def _pool_scatter(buf, dst_blocks, src):
+    """Scatter a dense (L, N, S, ...) cache buffer into pool blocks: the
+    source's position axis splits into S/block_size whole blocks (S is
+    bucket-grained, block_size == SEQ_BUCKET, so it always divides) and
+    each lands at ``dst_blocks[i]`` of the (L, n_blocks, block_size, ...)
+    arena. Destinations >= n_blocks (the OOB sentinel) are DROPPED by
+    XLA's out-of-bounds scatter rule — prefix-ALIASED source blocks
+    (their pool content is shared, never rewritten), pad blocks beyond a
+    row's reservation, and warmup's dead dispatch all ride it."""
+    if isinstance(buf, dict):
+        return {"q": _pool_scatter(buf["q"], dst_blocks, src["q"]),
+                "s": _pool_scatter(buf["s"], dst_blocks, src["s"])}
+    l, bs = buf.shape[0], buf.shape[2]
+    n_src = (src.shape[1] * src.shape[2]) // bs
+    r = src.reshape((l, n_src, bs) + buf.shape[3:])
+    return buf.at[:, dst_blocks.reshape(-1)].set(r.astype(buf.dtype))
+
+
+def _admit_row_paged(cache, logits_buf, row, dst_blocks, bt_row, row_cache,
+                     row_logits):
+    """Paged form of ``_admit_row``: scatter the batch-1 prefilled row
+    cache into the row's allocated pool blocks and install its block
+    table. ``dst_blocks`` (s1/bs,) carries the pool destination per
+    source block (OOB = dropped: aliased prefix blocks and beyond-
+    reservation pad); ``bt_row`` (nbpr,) is the row's new table (scratch
+    0 above the reservation). ``row == max_batch`` drops the bt/length/
+    logits update — warmup's dead dispatch."""
+    new_cache = {
+        "k": _pool_scatter(cache["k"], dst_blocks, row_cache["k"]),
+        "v": _pool_scatter(cache["v"], dst_blocks, row_cache["v"]),
+        "bt": cache["bt"].at[row].set(bt_row),
+        "length": cache["length"].at[row].set(row_cache["length"][0]),
+    }
+    return new_cache, logits_buf.at[row].set(row_logits[0])
+
+
+_admit_row_paged_jit = functools.partial(
+    jax.jit, donate_argnames=("cache", "logits_buf")
+)(_admit_row_paged)
+
+
+def _admit_wave_paged(cache, logits_buf, rows, dst_blocks, bt_rows, wave_k,
+                      wave_v, wave_len, wave_logits):
+    """Paged form of ``_admit_wave``: every member's row cache scatters
+    into ITS block run in one dispatch. ``dst_blocks`` (Nb, s1/bs) maps
+    (member, source block) -> pool block (OOB = dropped: pad members,
+    NaN-quarantined members, aliased prefix blocks, beyond-reservation
+    pad); ``rows``/``bt_rows`` install tables and lengths with the same
+    OOB-drop rule as the dense wave scatter."""
+    new_cache = {
+        "k": _pool_scatter(cache["k"], dst_blocks, wave_k),
+        "v": _pool_scatter(cache["v"], dst_blocks, wave_v),
+        "bt": cache["bt"].at[rows].set(bt_rows),
+        "length": cache["length"].at[rows].set(
+            wave_len.astype(cache["length"].dtype)),
+    }
+    return new_cache, logits_buf.at[rows].set(wave_logits)
+
+
+_admit_wave_paged_jit = functools.partial(
+    jax.jit, donate_argnames=("cache", "logits_buf")
+)(_admit_wave_paged)
+
+
+def _gather_blocks(k, v, blocks):
+    """Dense (L, 1, m*bs, KV, hd) view of ``m`` pool blocks — a paged
+    prefix entry's KV for the exclusive suffix / lane-seed paths (the
+    same values ``_slice_prefix_block`` would have copied out of a dense
+    row; a gather is a copy, so chains stay byte-identical). Inputs are
+    never donated: the pool is the resident cache."""
+
+    def g(buf):
+        if isinstance(buf, dict):
+            return {"q": g(buf["q"]), "s": g(buf["s"])}
+        x = buf[:, blocks]  # (L, m, bs, KV, hd)
+        return x.reshape((x.shape[0], 1, x.shape[1] * x.shape[2])
+                         + x.shape[3:])
+
+    return g(k), g(v)
+
+
+_gather_blocks_jit = functools.partial(
+    jax.jit, donate_argnames=()
+)(_gather_blocks)
+
+
+def _pool_write(cache, dst_blocks, src_k, src_v):
+    """Write dense (L, 1, S) K/V buffers into entry-owned pool blocks —
+    the operator ``set_prefix`` insert (admissions ride the richer
+    ``_admit_row_paged``)."""
+    return {**cache,
+            "k": _pool_scatter(cache["k"], dst_blocks, src_k),
+            "v": _pool_scatter(cache["v"], dst_blocks, src_v)}
+
+
+_pool_write_jit = functools.partial(
+    jax.jit, donate_argnames=("cache",)
+)(_pool_write)
 
 
 def _slice_prefix_block(k, v, row, bucket: int):
@@ -1062,6 +1245,45 @@ def _get_sharded_admit_wave(flat_cache_sh, cache_treedef, logits_sh):
     )
 
 
+@functools.lru_cache(maxsize=16)
+def _get_sharded_admit_paged(flat_cache_sh, cache_treedef, logits_sh):
+    """Paged row admission under a mesh, with the pool/table placement
+    pinned (the donated-cache aliasing rule, same as the dense admit)."""
+    cache_sh = jax.tree_util.tree_unflatten(cache_treedef, list(flat_cache_sh))
+    return jax.jit(
+        _admit_row_paged,
+        donate_argnums=(0, 1),
+        out_shardings=(cache_sh, logits_sh),
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _get_sharded_admit_wave_paged(flat_cache_sh, cache_treedef, logits_sh):
+    cache_sh = jax.tree_util.tree_unflatten(cache_treedef, list(flat_cache_sh))
+    return jax.jit(
+        _admit_wave_paged,
+        donate_argnums=(0, 1),
+        out_shardings=(cache_sh, logits_sh),
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _get_sharded_pool_write(flat_cache_sh, cache_treedef):
+    cache_sh = jax.tree_util.tree_unflatten(cache_treedef, list(flat_cache_sh))
+    return jax.jit(
+        _pool_write, donate_argnums=(0,), out_shardings=cache_sh,
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _get_sharded_gather_blocks(block_sh, quant):
+    """Paged entry-KV gather under a mesh: output block pinned to the
+    prefix-entry placement (``parallel/serving.prefix_block_sharding``),
+    same as the dense ``_get_sharded_slice_prefix``."""
+    out_sh = ({"q": block_sh, "s": block_sh} if quant else block_sh)
+    return jax.jit(_gather_blocks, out_shardings=(out_sh, out_sh))
+
+
 @functools.lru_cache(maxsize=32)
 def _get_sharded_slice_prefix(bucket, block_sh, quant):
     """Entry copy (insert-on-prefill) under a mesh, with the output block
@@ -1228,6 +1450,15 @@ class _Request:
     # — the pre-SLO behavior). Scoring reads clocks and host state only,
     # so chains are byte-identical with or without an SLO attached.
     slo: Optional[SLO] = None
+    # Paged KV reservation (ISSUE 12): pool blocks this request holds —
+    # ``owned`` at refcount 1 (its private writable run), ``aliased``
+    # shared with a prefix entry (incref'd full blocks below the
+    # divergence point). Both decref on EVERY terminal/export path
+    # (``_paged_release``); ``kv_bt_written`` marks that the row's
+    # device block table points at them and must be reset to scratch.
+    kv_blocks_owned: List[int] = field(default_factory=list)
+    kv_blocks_aliased: List[int] = field(default_factory=list)
+    kv_bt_written: bool = False
 
 
 class ContinuousBatcher:
@@ -1295,6 +1526,8 @@ class ContinuousBatcher:
         slo_window: int = 256,
         mem_headroom_bytes: int = 0,
         mem_capacity_bytes: int = 0,
+        kv_layout: str = "dense",
+        kv_pool_blocks: int = 0,
     ):
         if prefill_chunk and (2 * SEQ_BUCKET) % prefill_chunk:
             # A chunk that does not divide the bucket grain would force
@@ -1349,9 +1582,46 @@ class ContinuousBatcher:
         if self._dtype not in (jnp.bfloat16, jnp.float32):
             self._dtype = jnp.bfloat16  # quantized tree: compute in bf16
         self.kv_quant = kv_quant
-        self.cache = llama_mod.init_kv_cache(
-            cfg.llama, max_batch, max_len, dtype=self._dtype, quant=kv_quant
-        )
+        # KV layout (ISSUE 12 tentpole): "dense" keeps one (B, max_len)
+        # row per batch slot; "paged" replaces it with ONE block-pool
+        # arena (n_blocks × SEQ_BUCKET positions per layer/plane) plus
+        # per-row int32 block tables — allocation becomes block-granular
+        # (admission gated by FREE BLOCKS, not batch × max_len), prefix
+        # "copies" become table aliasing with copy-on-write, and every
+        # jit-visible shape stays static. Chains are byte-identical
+        # across layouts (the gather/scatter translation is pure
+        # indexing — tests/test_paged_blocks.py holds the full matrix).
+        if kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'dense' or 'paged', got {kv_layout!r}")
+        self.kv_layout = kv_layout
+        self._paged = kv_layout == "paged"
+        self._pool: Optional[serve_blocks.BlockPool] = None
+        if self._paged:
+            self._kv_block_size = SEQ_BUCKET
+            self._nbpr = max_len // SEQ_BUCKET  # table width (blocks/row)
+            # Default pool = dense-equivalent capacity (+1 scratch): the
+            # layout change alone never shrinks what fits. Operators cap
+            # it lower (--kv_pool_blocks) to trade peak concurrency for
+            # HBM — the bench's paged batch-sweep leg does exactly that.
+            n_blocks = int(kv_pool_blocks) or (max_batch * self._nbpr + 1)
+            min_blocks = (2 * SEQ_BUCKET) // SEQ_BUCKET + 1
+            if n_blocks < min_blocks:
+                # One prompt-grain bucket + scratch is the floor; a
+                # request needing more than the pool holds is rejected
+                # loudly at submit() (the per-request fit rule).
+                raise ValueError(
+                    f"kv_pool_blocks={n_blocks} cannot hold one prompt "
+                    f"bucket ({min_blocks - 1} blocks + 1 scratch)")
+            self.cache = llama_mod.init_paged_kv_cache(
+                cfg.llama, max_batch, max_len, n_blocks, SEQ_BUCKET,
+                dtype=self._dtype, quant=kv_quant,
+            )
+        else:
+            self.cache = llama_mod.init_kv_cache(
+                cfg.llama, max_batch, max_len, dtype=self._dtype,
+                quant=kv_quant
+            )
         # Vocab from the actual lm_head leaf, not cfg: special-token
         # registration can grow the embeddings past cfg.llama.vocab_size
         # (prepare_model's resize).
@@ -1436,8 +1706,22 @@ class ContinuousBatcher:
         # from the live buffers so int8-KV halves it automatically).
         _kv_leaves = jax.tree_util.tree_leaves(
             {"k": self.cache["k"], "v": self.cache["v"]})
+        _kv_positions = (
+            self._pool_n_blocks() * self._kv_block_size if self._paged
+            else max_batch * self.max_len)
         self._kv_pos_bytes = max(
-            1, sum(x.nbytes for x in _kv_leaves) // (max_batch * self.max_len))
+            1, sum(x.nbytes for x in _kv_leaves) // _kv_positions)
+        if self._paged:
+            # The ONE allocator rows, prefix entries and COW share
+            # (serve_blocks.BlockPool): refcounted free list over the
+            # arena, scratch block 0 reserved for dead-row writes.
+            self._pool = serve_blocks.BlockPool(
+                self._pool_n_blocks(), SEQ_BUCKET,
+                block_bytes=SEQ_BUCKET * self._kv_pos_bytes)
+            self.block_deferrals = 0
+            if self._prefix_cache is not None:
+                # Paged entries pin pool blocks; eviction decrefs them.
+                self._prefix_cache.pool = self._pool
         # Pipelined scheduling (the default): between-segment control state
         # (frozen / n_rem / base_pos) ALSO lives on device, updated
         # in-graph by the segment kernels, so segment N+1 is dispatched
@@ -1511,9 +1795,22 @@ class ContinuousBatcher:
         obs_memory.LEDGER.register(
             "weights", f"shared/params-{id(params):x}",
             obs_memory.params_bytes(params))
-        obs_memory.LEDGER.register(
-            "kv_cache", f"{self._mem_owner}/kv_cache",
-            obs_memory.params_bytes(self.cache))
+        if self._paged:
+            # Ledger split (ISSUE 12 satellite): the arena and the table
+            # are separate components, so /memory shows where paged
+            # bytes live (the table is the only term that scales with
+            # max_batch; the pool scales with blocks).
+            obs_memory.LEDGER.register(
+                "kv_pool", f"{self._mem_owner}/kv_pool",
+                obs_memory.params_bytes(
+                    {"k": self.cache["k"], "v": self.cache["v"]}))
+            obs_memory.LEDGER.register(
+                "kv_block_table", f"{self._mem_owner}/kv_block_table",
+                self.cache["bt"].nbytes + self.cache["length"].nbytes)
+        else:
+            obs_memory.LEDGER.register(
+                "kv_cache", f"{self._mem_owner}/kv_cache",
+                obs_memory.params_bytes(self.cache))
         obs_memory.LEDGER.register(
             "logits", f"{self._mem_owner}/logits", self.logits.nbytes)
         if self.speculative:
@@ -1561,6 +1858,8 @@ class ContinuousBatcher:
             return  # __init__ raised before registration
         try:
             for comp, key in (("kv_cache", "kv_cache"),
+                              ("kv_pool", "kv_pool"),
+                              ("kv_block_table", "kv_block_table"),
                               ("logits", "logits"),
                               ("ids_buf", "ids_buf"),
                               ("draft", "spec_drafts"),
@@ -1706,15 +2005,37 @@ class ContinuousBatcher:
                 n += 1
             # Admission executable (keyed per bucket): write into row 0 —
             # dead storage for a FREE row, overwritten at real admission.
-            if self.mesh is not None:
-                admit = _get_sharded_admit(
-                    self._cache_flat_sh, self._cache_treedef, self._logits_sh
+            # Paged: every destination is the OOB sentinel (all writes
+            # dropped) and the row index is out of bounds too — the
+            # executable compiles, the pool stays untouched.
+            if self._paged:
+                oob_dst = jnp.full((s1 // self._kv_block_size,),
+                                   self._pool.n_blocks, jnp.int32)
+                btr = jnp.zeros((self._nbpr,), jnp.int32)
+                if self.mesh is not None:
+                    oob_dst = self._serving.replicate(oob_dst, self.mesh)
+                    btr = self._serving.replicate(btr, self.mesh)
+                    admit = _get_sharded_admit_paged(
+                        self._cache_flat_sh, self._cache_treedef,
+                        self._logits_sh
+                    )
+                else:
+                    admit = _admit_row_paged_jit
+                self.cache, self.logits = admit(
+                    self.cache, self.logits, self.max_batch, oob_dst, btr,
+                    row_cache, row_logits
                 )
             else:
-                admit = _admit_row_jit
-            self.cache, self.logits = admit(
-                self.cache, self.logits, 0, row_cache, row_logits
-            )
+                if self.mesh is not None:
+                    admit = _get_sharded_admit(
+                        self._cache_flat_sh, self._cache_treedef,
+                        self._logits_sh
+                    )
+                else:
+                    admit = _admit_row_jit
+                self.cache, self.logits = admit(
+                    self.cache, self.logits, 0, row_cache, row_logits
+                )
             n += 1
         # Zero the dummy row length so its pre-admission frozen-row write
         # slot stays far from the buffer edge (hygiene; writes above the
@@ -1863,17 +2184,46 @@ class ContinuousBatcher:
             _, row_cache = _prefill_jit(
                 self.params, self.cfg, padded, mask, row_cache, True
             )
+        blocks = None
+        kv = {"k": row_cache["k"], "v": row_cache["v"]}
+        if self._paged:
+            # The operator entry owns its own block run (refcount 1 from
+            # the cache): scatter the prefilled row into fresh pool
+            # blocks; admissions then alias them like any other entry.
+            nblk = s1p // self._kv_block_size
+            blocks = self._pool.alloc(nblk)
+            if blocks is None:
+                self._prefix_cache.reclaim_blocks(self._pool, nblk)
+                blocks = self._pool.alloc(nblk)
+            if blocks is None:
+                raise ValueError(
+                    f"prefix entry needs {nblk} pool blocks; only "
+                    f"{self._pool.free_blocks()} free (raise "
+                    f"--kv_pool_blocks)")
+            dst = jnp.asarray(blocks, jnp.int32)
+            if self.mesh is not None:
+                dst = self._serving.replicate(dst, self.mesh)
+                fn = _get_sharded_pool_write(
+                    self._cache_flat_sh, self._cache_treedef)
+                self.cache = fn(self.cache, dst, row_cache["k"],
+                                row_cache["v"])
+            else:
+                self.cache = _pool_write_jit(
+                    self.cache, dst, row_cache["k"], row_cache["v"])
+            kv = None
         entry = _PrefixEntry(
             ids=tuple(ids),
             # Identity of the prefix's event stream: admissions whose
             # pixels differ must NOT reuse this KV.
             pixels_key=(_pixels_key(pixel_values) if n_ev == 1 else None),
             has_event=n_ev == 1,
-            kv={"k": row_cache["k"], "v": row_cache["v"]},
+            kv=kv, blocks=blocks,
             length=p_len, bucket=s1p,
             nbytes=s1p * self._kv_pos_bytes,
         )
         if not self._prefix_cache.insert(entry):
+            if blocks:
+                self._pool.decref(blocks)
             raise ValueError(
                 f"prefix entry ({entry.nbytes} bytes at bucket {s1p}) "
                 f"exceeds the prefix-cache budget "
@@ -1997,6 +2347,7 @@ class ContinuousBatcher:
         new_len = jnp.asarray([prompt_len], jnp.int32)
         last_idx = jnp.asarray(suf_len - 1, jnp.int32)
         plen_arr = jnp.asarray([entry.length], jnp.int32)
+        ekv = self._entry_kv(entry)
         if self.mesh is not None:
             emb = self._serving.shard_batch_array(emb, self.mesh)
             row_sh = jax.tree_util.tree_map(lambda x: x.sharding, row_cache)
@@ -2009,12 +2360,12 @@ class ContinuousBatcher:
                 hidden_sh,
             )
             last, hidden, row_cache = fn(
-                self.params, entry.kv["k"], entry.kv["v"], plen_arr,
+                self.params, ekv["k"], ekv["v"], plen_arr,
                 row_cache, emb, new_len, last_idx,
             )
         else:
             last, hidden, row_cache = _prefix_prefill_jit(
-                self.params, self.cfg, entry.kv["k"], entry.kv["v"],
+                self.params, self.cfg, ekv["k"], ekv["v"],
                 plen_arr, row_cache, emb, new_len, last_idx,
             )
         if record:
@@ -2058,8 +2409,9 @@ class ContinuousBatcher:
                         "s": jnp.concatenate([b["s"] for b in blocks], 1)}
             return jnp.concatenate(blocks, axis=1)
 
-        pks = [pad_block(m[2].kv["k"], s_pre) for m in members]
-        pvs = [pad_block(m[2].kv["v"], s_pre) for m in members]
+        ekvs = [self._entry_kv(m[2]) for m in members]
+        pks = [pad_block(kv["k"], s_pre) for kv in ekvs]
+        pvs = [pad_block(kv["v"], s_pre) for kv in ekvs]
         if nb > n:
             # Pad slots reuse the first member's block (their rows scatter
             # out of bounds and their length is pinned to 1 below).
@@ -2109,6 +2461,10 @@ class ContinuousBatcher:
             hidden if self.draft_head is not None else None, prompt_lens,
             entries=[m[2] for m in members], path="suffix_wave",
         )
+        for m in members:
+            # Selection pins drain after the wave read every entry
+            # (surviving rows hold their own activation pins).
+            self._drain_entry_pin(m[2])
 
     def submit(self, input_ids: Sequence[int], pixel_values,
                max_new_tokens: int = 64,
@@ -2165,6 +2521,17 @@ class ContinuousBatcher:
                 f"request does not fit: prompt {prompt_len} + budget "
                 f"{max_new_tokens} exceeds server max_len {self.max_len}"
             )
+        if self._paged:
+            need = self._blocks_needed(prompt_len, max_new_tokens)
+            if need > self._pool.usable:
+                # Same loud-at-submit rule as the max_len check: a
+                # request no pool state could ever cover must not sit in
+                # the queue deferring forever.
+                raise ValueError(
+                    f"request does not fit: needs {need} KV blocks, the "
+                    f"pool holds {self._pool.usable} (raise "
+                    f"--kv_pool_blocks)"
+                )
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(rid, ids, pixel_values, max_new_tokens)
@@ -2208,6 +2575,8 @@ class ContinuousBatcher:
                 self._lanes.remove(l)
                 self._lane_free.append(l.slot)
                 self.rows[l.row] = None
+                if l.entry is not None:
+                    self._drain_entry_pin(l.entry)
                 self._finish_forced(l.req, STATUS_CANCELLED)
                 return True
         for r, req in enumerate(self.rows):
@@ -2251,6 +2620,8 @@ class ContinuousBatcher:
             by_rid[p.req.rid] = p.req
         for l in self._lanes:
             self.rows[l.row] = None  # lane KV is dead storage
+            if l.entry is not None:
+                self._drain_entry_pin(l.entry)
             by_rid[l.req.rid] = l.req
         self._lanes = []
         self._lane_free = list(range(self._lane_cap))
@@ -2268,10 +2639,16 @@ class ContinuousBatcher:
         out: List[Dict[str, Any]] = []
         for rid in sorted(by_rid):
             req = by_rid[rid]
+            if self._paged:
+                # A drained request's blocks free EXACTLY (the fleet
+                # handoff seam): owned + aliased refs drop here; the
+                # device tables reset wholesale below.
+                req.kv_bt_written = False
+                self._paged_release(req)
             if req.prefix_entry is not None:
                 # Same pin-drain rule as _record_finish: the entry must
                 # not stay unevictable behind a request that left.
-                req.prefix_entry.pins -= 1
+                self._drain_entry_pin(req.prefix_entry)
                 req.prefix_entry = None
             if req.deadline is not None:
                 self._n_deadlines -= 1
@@ -2297,6 +2674,12 @@ class ContinuousBatcher:
                                if req.deadline is not None else None),
                 "slo": req.slo,
             })
+        if self._paged:
+            # Every row left the scheduler: all tables back to scratch,
+            # so no dead row's frozen writes can reach a block the next
+            # admissions re-allocate.
+            self.cache = {**self.cache,
+                          "bt": jnp.zeros_like(self.cache["bt"])}
         obs_metrics.SERVE_QUEUE_DEPTH.set(0)
         obs_metrics.SERVE_ACTIVE_ROWS.set(0)
         return out
@@ -2309,6 +2692,27 @@ class ContinuousBatcher:
         self._drain()
         out, self.finished = self.finished, {}
         return out
+
+    def reset_prefix_cache(self) -> None:
+        """Swap in a fresh (same-budget) prefix cache — the bench's
+        per-measured-point reset. This is THE supported reset: replacing
+        ``_prefix_cache`` by hand would orphan a paged cache's pinned
+        block runs (their refs would never decref — the pool drains
+        monotonically until admission livelocks on the block gate).
+        ``clear()`` releases the old entries' blocks under the
+        deferred-on-pins rule first; the old object's ledger key is
+        detached so its GC cannot release the successor's bytes."""
+        if self._prefix_cache is None:
+            return
+        old = self._prefix_cache
+        old.clear()
+        # Tombstone the old key: __del__ would otherwise release the
+        # NEW cache's ledger entry (same owner-derived key).
+        old._mem_key = f"{self._mem_owner}/prefix_cache_dropped{id(old):x}"
+        self._prefix_cache = PrefixCache(old.budget)
+        self._prefix_cache._mem_key = f"{self._mem_owner}/prefix_cache"
+        if self._paged:
+            self._prefix_cache.pool = self._pool
 
     def prefix_cache_stats(self) -> Dict[str, Any]:
         """Prefix-KV cache snapshot (``GET /prefix_cache``): entry list,
@@ -2342,6 +2746,9 @@ class ContinuousBatcher:
             "capacity_bytes": self._mem_capacity,
             "deferrals": self.mem_deferrals,
         }
+        if self._paged:
+            s["kv_blocks"] = self._pool.stats()
+            s["kv_blocks"]["deferrals"] = self.block_deferrals
         return s
 
     def memory_estimate(self) -> Dict[str, Any]:
@@ -2364,6 +2771,9 @@ class ContinuousBatcher:
             vocab=int(self.logits.shape[1]),
             mesh_shape=(dict(self.mesh.shape)
                         if self.mesh is not None else None),
+            kv_layout=self.kv_layout,
+            kv_pool_blocks=(self._pool_n_blocks() if self._paged else 0),
+            kv_block_size=(self._kv_block_size if self._paged else 0),
         )
 
     def memory_stats(self, reconcile: bool = True) -> Dict[str, Any]:
@@ -2677,6 +3087,8 @@ class ContinuousBatcher:
             self._lanes.remove(l)
             self._lane_free.append(l.slot)
             self.rows[l.row] = None
+            if l.entry is not None:
+                self._drain_entry_pin(l.entry)
             self._finish_forced(l.req, STATUS_DEADLINE)
         for r, req in enumerate(self.rows):
             if req is not None and not self.frozen[r] and expired(req):
@@ -3074,11 +3486,18 @@ class ContinuousBatcher:
         self._record_finish(req, status)
 
     def _record_finish(self, req: _Request, status: str) -> None:
+        if self._paged:
+            # Block reservation drains on EVERY terminal path (EOS,
+            # budget, deadline, cancel, quarantine) — the paged twin of
+            # the prefix-pin drain below; freed blocks are what the
+            # admission gate hands the next deferred request.
+            self._paged_release(req)
         if req.prefix_entry is not None:
             # Drain the refcount pin on EVERY terminal path (EOS, budget,
             # deadline, cancel, quarantine): the entry becomes evictable
-            # once its last in-flight row is gone.
-            req.prefix_entry.pins -= 1
+            # once its last in-flight row is gone (and a detached paged
+            # entry's deferred block run frees on the last drain).
+            self._drain_entry_pin(req.prefix_entry)
             req.prefix_entry = None
         if req.deadline is not None:
             self._n_deadlines -= 1
@@ -3279,6 +3698,11 @@ class ContinuousBatcher:
         # boundary, with a row reserved and an entry about to be read.
         faults.maybe_fail("serve.prefix_copy")
         faults.maybe_delay("serve.prefix_copy")
+        # LANE pin (past the fault probes, so a tripped admission never
+        # leaks it): the lane re-reads the entry at finish (the int8
+        # overlay) and its seed blocks must stay un-recycled for the
+        # lane's whole pendency; every lane-termination path drains it.
+        entry.pins += 1
         t0 = time.perf_counter()
         self._ensure_lane_buffers(max(s1, entry.bucket))
         slot = self._lane_free.pop()
@@ -3288,8 +3712,9 @@ class ContinuousBatcher:
                 self._lane_flat_sh, self._lane_treedef)
         else:
             seed = _lane_seed_jit
+        ekv = self._entry_kv(entry)
         self._lane_cache = seed(
-            self._lane_cache, slot_arr, entry.kv["k"], entry.kv["v"])
+            self._lane_cache, slot_arr, ekv["k"], ekv["v"])
         emb = self._suffix_embed(entry, req.pixel_values, suffix_ids,
                                  suf_len, suf_len)
         plen = entry.length
@@ -3344,6 +3769,8 @@ class ContinuousBatcher:
         whichever path the next boundary picks."""
         for l in reversed(self._lanes):
             self.rows[l.row] = None  # row stays frozen; lane KV is dead
+            if l.entry is not None:
+                self._drain_entry_pin(l.entry)
             self.queue.appendleft(l.req)
         self._lanes = []
         self._lane_free = list(range(self._lane_cap))
@@ -3365,7 +3792,8 @@ class ContinuousBatcher:
             pk = pv = None
             plen = 0
             if self.kv_quant and l.entry is not None:
-                pk, pv = l.entry.kv["k"], l.entry.kv["v"]
+                ekv = self._entry_kv(l.entry)
+                pk, pv = ekv["k"], ekv["v"]
                 plen = l.entry.length
             slot_arr = jnp.asarray(l.slot, jnp.int32)
             if self.mesh is not None:
@@ -3393,7 +3821,183 @@ class ContinuousBatcher:
                 l.last_hidden if self.draft_head is not None else None,
                 prefix_entry=l.entry, path="lane",
             )
+            if l.entry is not None:
+                # Lane pin drains once the activation holds its own.
+                self._drain_entry_pin(l.entry)
         return done
+
+    # -- paged KV block pool (ISSUE 12) -----------------------------------
+
+    def _pool_n_blocks(self) -> int:
+        buf = (self.cache["k"]["q"] if isinstance(self.cache["k"], dict)
+               else self.cache["k"])
+        return buf.shape[1]
+
+    def _blocks_needed(self, prompt_len: int, max_new: int) -> int:
+        """Blocks one request reserves at admission: cover its prompt
+        BUCKET (the admission scatter writes whole bucket-grain blocks)
+        and its decode horizon ``prompt + budget + slack`` (the same
+        slack submit() validates — speculative rows write one verify
+        window past their last commit). Reserving the full horizon up
+        front is what makes block admission deadlock-free: a row that
+        admitted can always finish, no mid-decode allocation, no
+        preemption machinery."""
+        grain = 2 * SEQ_BUCKET
+        bucket = min(((prompt_len + grain - 1) // grain) * grain,
+                     self.max_len)
+        slack = 1 + self.speculative
+        cover = min(max(bucket, prompt_len + max_new + slack), self.max_len)
+        return self._pool.blocks_for(cover)
+
+    def _paged_admit_gate(self) -> bool:
+        """Used-token admission (the tentpole's scheduling half): the
+        queue head admits only when its whole block reservation fits the
+        pool's FREE list — not when a dense row would have fit. Under
+        pressure the gate first reclaims LRU unpinned prefix entries
+        (their pinned runs are the only idle pool capacity — eviction
+        and row allocation share the one allocator); still short, the
+        head stays queued and finishing rows free the blocks it needs.
+        Deferral is pure timing: whatever chain a request decodes is
+        unchanged, same as the byte-headroom guard."""
+        req = self.queue[0]
+        need = self._blocks_needed(req.prompt_len, req.max_new_tokens)
+        if self._pool.free_blocks() >= need:
+            return True
+        if self._prefix_cache is not None:
+            self._prefix_cache.reclaim_blocks(self._pool, need)
+            if self._pool.free_blocks() >= need:
+                return True
+        self._paged_defer(req, need)
+        return False
+
+    def _paged_defer(self, req, need: int) -> None:
+        self.block_deferrals += 1
+        obs_metrics.SERVE_KV_BLOCK_DEFERRALS.inc()
+        obs_trace.instant("kv_block_defer", cat="mem", need_blocks=need,
+                          free_blocks=self._pool.free_blocks())
+        if obs_journey.enabled():
+            obs_journey.event(self._journey_owner, req.rid,
+                              "kv_block_defer", need_blocks=need,
+                              free_blocks=self._pool.free_blocks())
+
+    def _paged_requeue(self, req, row: int) -> None:
+        """Undo a pop whose reservation failed: release the row, put the
+        request back at the queue FRONT (original order), count the
+        deferral. Nothing was allocated (alloc never partially grants)
+        and nothing touched device state."""
+        self.rows[row] = None
+        req.row = -1
+        self.queue.appendleft(req)
+        obs_metrics.SERVE_QUEUE_DEPTH.set(len(self.queue))
+        self._paged_defer(
+            req, self._blocks_needed(req.prompt_len, req.max_new_tokens))
+
+    def _paged_reserve(self, req, s1: int,
+                       entry: Optional[_PrefixEntry] = None) -> bool:
+        """Allocate the request's block reservation (aliasing the entry's
+        full blocks below the divergence point on a prefix hit). False =
+        pool cannot cover it right now — the caller re-queues the
+        request (never a partial grant)."""
+        slack = 1 + self.speculative
+        cover = min(max(s1, req.prompt_len + req.max_new_tokens + slack),
+                    self.max_len)
+        total = self._pool.blocks_for(cover)
+        aliased: List[int] = []
+        if entry is not None and entry.blocks:
+            n_shared = min(entry.length // self._kv_block_size, total,
+                           len(entry.blocks))
+            aliased = list(entry.blocks[:n_shared])
+        owned = self._pool.alloc(total - len(aliased))
+        if owned is None:
+            return False
+        if aliased:
+            self._pool.incref(aliased)
+            if entry.length % self._kv_block_size:
+                # The entry diverges mid-block: the admission scatter
+                # re-creates that block's shared head in the row's first
+                # OWNED block — THE copy-on-write copy, counted here.
+                self._pool.note_cow()
+        req.kv_blocks_aliased = aliased
+        req.kv_blocks_owned = owned
+        return True
+
+    def _paged_bt_row(self, req) -> np.ndarray:
+        """The row's block table: reservation first (aliased run, then
+        owned), scratch block 0 above it (frozen writes land there)."""
+        bt = np.full((self._nbpr,), serve_blocks.SCRATCH_BLOCK, np.int32)
+        run = req.kv_blocks_aliased + req.kv_blocks_owned
+        bt[: len(run)] = run
+        return bt
+
+    def _paged_dst_blocks(self, req, s1: int) -> np.ndarray:
+        """Scatter destinations for the row's (s1-bucket) prefilled
+        cache: aliased source blocks and blocks beyond the reservation
+        (pure pad — a wave/lane bucket can exceed a short member's own)
+        go to the OOB sentinel, which XLA drops."""
+        n_src = s1 // self._kv_block_size
+        oob = self._pool.n_blocks
+        dst = np.full((n_src,), oob, np.int32)
+        na = len(req.kv_blocks_aliased)
+        own = req.kv_blocks_owned
+        for j in range(na, n_src):
+            if j - na < len(own):
+                dst[j] = own[j - na]
+        return dst
+
+    def _paged_release(self, req) -> None:
+        """Return the request's reservation on EVERY terminal/export
+        path, and point its dead row's table back at scratch so the
+        segment kernels' unconditional frozen writes can never land in
+        a recycled block."""
+        if req.kv_blocks_owned:
+            self._pool.decref(req.kv_blocks_owned)
+            req.kv_blocks_owned = []
+        if req.kv_blocks_aliased:
+            self._pool.decref(req.kv_blocks_aliased)
+            req.kv_blocks_aliased = []
+        if req.kv_bt_written and req.row >= 0:
+            self.cache = {
+                **self.cache,
+                "bt": self.cache["bt"].at[req.row].set(
+                    serve_blocks.SCRATCH_BLOCK),
+            }
+            req.kv_bt_written = False
+
+    def _drain_entry_pin(self, entry: _PrefixEntry) -> None:
+        """Drop one refcount pin; on the LAST drain of a DETACHED paged
+        entry, release its deferred block run (see
+        ``PrefixCache._release_blocks_locked``). Every pin site —
+        selection (hit chosen for this boundary's admission), pending
+        lane, active row — drains through here, so a replaced/evicted
+        entry's blocks can never free while something still reads
+        them."""
+        entry.pins -= 1
+        if (entry.pins <= 0 and entry.detached and entry.blocks
+                and self._pool is not None):
+            self._pool.decref(entry.blocks)
+            entry.blocks = None
+            entry.detached = False
+
+    def _entry_kv(self, entry: _PrefixEntry) -> Dict[str, Any]:
+        """The entry's dense (L, 1, bucket) KV view: stored buffers for
+        dense-layout entries; a pool gather for paged ones (same values
+        the dense copy would carry — the exclusive suffix / lane paths
+        stay layout-agnostic)."""
+        if entry.kv is not None:
+            return entry.kv
+        blocks = jnp.asarray(entry.blocks, jnp.int32)
+        if self.mesh is not None:
+            blocks = self._serving.replicate(blocks, self.mesh)
+            fn = _get_sharded_gather_blocks(
+                self._serving.prefix_block_sharding(self.mesh,
+                                                    self.cfg.llama),
+                self.kv_quant,
+            )
+            k, v = fn(self.cache["k"], self.cache["v"], blocks)
+        else:
+            k, v = _gather_blocks_jit(self.cache["k"], self.cache["v"],
+                                      blocks)
+        return {"k": k, "v": v}
 
     def _admit(self) -> bool:
         """Returns True when this step did admission work (advanced a
@@ -3442,6 +4046,8 @@ class ContinuousBatcher:
                        for r in range(self.max_batch))):
             if piggy and not self._lane_free:
                 break  # lanes at the token budget: the rest stay queued
+            if self._paged and not self._paged_admit_gate():
+                break  # pool can't cover the head's block reservation
             req = self.queue.popleft()
             did_work = True
             t_deq = time.perf_counter()
@@ -3472,6 +4078,14 @@ class ContinuousBatcher:
             if hit is not None:
                 entry, suffix_ids = hit
                 fit = self._prefix_fit(entry, suffix_ids)
+                if fit is not None and self._paged and not \
+                        self._paged_reserve(req, fit[3], entry):
+                    # The gate pre-checked the FULL (no-aliasing) need,
+                    # but a racing entry eviction or a one-grain suffix
+                    # overshoot can still lose the allocation: requeue
+                    # at the front, never a partial grant.
+                    self._paged_requeue(req, row)
+                    break
                 if fit is not None:
                     obs_journey.event(
                         self._journey_owner, req.rid, "prefix", hit=True,
@@ -3480,12 +4094,24 @@ class ContinuousBatcher:
                         self._start_suffix_lane(req, row, entry,
                                                 suffix_ids, fit)
                         continue
+                    # SELECTION pin: the entry must survive (and a paged
+                    # entry's blocks must stay un-recycled) until this
+                    # boundary's suffix admission has read it — the
+                    # block-gate's entry reclaim skips pinned entries.
+                    entry.pins += 1
                     hits.append((req, row, entry, suffix_ids, fit))
                     continue
             if self._prefix_cache is not None:
                 self._prefix_cache.count_miss()
                 obs_journey.event(self._journey_owner, req.rid, "prefix",
                                   hit=False)
+            if self._paged:
+                grain = 2 * SEQ_BUCKET
+                s1 = min(((req.prompt_len + grain - 1) // grain) * grain,
+                         self.max_len)
+                if not self._paged_reserve(req, s1):
+                    self._paged_requeue(req, row)
+                    break
             if piggy:
                 self._start_full_lane(req, row)
                 continue
@@ -3513,20 +4139,34 @@ class ContinuousBatcher:
             obs_metrics.SERVE_ADMISSION_WAVE.observe(len(members))
             if len(members) == 1:
                 req, row, entry, suffix_ids, fit = members[0]
-                pre_admit = self._prefix_admit(entry, req.pixel_values,
-                                               suffix_ids)
-                if pre_admit is None:  # unreachable: fit pre-checked
-                    wave.append((req, row))
-                    continue
-                self._prefix_cache.count_hit(entry)
-                row_cache, row_logits, row_hidden, prompt_len = pre_admit
-                self._finish_admission(
-                    req, row, prompt_len, row_cache, row_logits,
-                    row_hidden if self.draft_head is not None else None,
-                    prefix_entry=entry, path="suffix",
-                )
+                try:
+                    pre_admit = self._prefix_admit(entry,
+                                                   req.pixel_values,
+                                                   suffix_ids)
+                    if pre_admit is None:  # unreachable: fit pre-checked
+                        wave.append((req, row))
+                        continue
+                    self._prefix_cache.count_hit(entry)
+                    (row_cache, row_logits, row_hidden,
+                     prompt_len) = pre_admit
+                    self._finish_admission(
+                        req, row, prompt_len, row_cache, row_logits,
+                        row_hidden if self.draft_head is not None
+                        else None,
+                        prefix_entry=entry, path="suffix",
+                    )
+                finally:
+                    # Selection pin drains once the admission read the
+                    # entry — or on the fault path (serve.prefix_copy),
+                    # where the engine sweep fails the request.
+                    self._drain_entry_pin(entry)
             else:
-                self._admit_suffix_wave(members)
+                try:
+                    self._admit_suffix_wave(members)
+                except BaseException:
+                    for m in members:
+                        self._drain_entry_pin(m[2])
+                    raise
         if not wave:
             return did_work
         obs_metrics.SERVE_ADMISSION_WAVE.observe(len(wave))
@@ -3568,6 +4208,23 @@ class ContinuousBatcher:
         that under-predicts is a guard that OOMs)."""
         grain = 2 * SEQ_BUCKET
         free = sum(1 for r in self.rows if r is None)
+        if self._paged:
+            # Paged repricing (ISSUE 12 satellite): the wave is priced
+            # at the BLOCK grain — each head's actual reservation — not
+            # as dense rows, and without the insert-on-prefill doubling
+            # (paged insert aliases the row's blocks; it copies
+            # nothing). The transient admission row-cache is bucket-
+            # sized, which the reservation already covers, so the old
+            # dense pricing would double-count headroom the pool no
+            # longer needs.
+            total = 0
+            for i, req in enumerate(self.queue):
+                if i >= free:
+                    break
+                total += (self._blocks_needed(req.prompt_len,
+                                              req.max_new_tokens)
+                          * self._pool.block_bytes)
+            return total
         factor = 2 if (self._prefix_cache is not None
                        and self.prefix_insert) else 1
         total = 0
@@ -3814,17 +4471,50 @@ class ContinuousBatcher:
             rows[i] = row
             good.append((i, req, row))
         rows_arr = jnp.asarray(rows)
-        if self.mesh is not None:
-            rows_arr = self._serving.replicate(rows_arr, self.mesh)
-            admit = _get_sharded_admit_wave(
-                self._cache_flat_sh, self._cache_treedef, self._logits_sh
+        if self._paged:
+            wk = wave_cache["k"]
+            s1 = (wk["q"] if isinstance(wk, dict) else wk).shape[2]
+            oob = self._pool.n_blocks
+            n_src = s1 // self._kv_block_size
+            dst = np.full((nb, n_src), oob, np.int32)
+            bt_rows = np.full((nb, self._nbpr),
+                              serve_blocks.SCRATCH_BLOCK, np.int32)
+            for i, req, row in good:
+                # Quarantined/pad slots keep all-OOB rows: their wave KV
+                # never touches the pool (their reservations were freed
+                # by _record_finish before this scatter was built).
+                dst[i] = self._paged_dst_blocks(req, s1)
+                bt_rows[i] = self._paged_bt_row(req)
+                req.kv_bt_written = True
+            dst_arr, bt_arr = jnp.asarray(dst), jnp.asarray(bt_rows)
+            if self.mesh is not None:
+                rows_arr = self._serving.replicate(rows_arr, self.mesh)
+                dst_arr = self._serving.replicate(dst_arr, self.mesh)
+                bt_arr = self._serving.replicate(bt_arr, self.mesh)
+                admit = _get_sharded_admit_wave_paged(
+                    self._cache_flat_sh, self._cache_treedef,
+                    self._logits_sh
+                )
+            else:
+                admit = _admit_wave_paged_jit
+            self.cache, self.logits = admit(
+                self.cache, self.logits, rows_arr, dst_arr, bt_arr,
+                wave_cache["k"], wave_cache["v"], wave_cache["length"],
+                wave_logits,
             )
         else:
-            admit = _admit_wave_jit
-        self.cache, self.logits = admit(
-            self.cache, self.logits, rows_arr, wave_cache["k"],
-            wave_cache["v"], wave_cache["length"], wave_logits,
-        )
+            if self.mesh is not None:
+                rows_arr = self._serving.replicate(rows_arr, self.mesh)
+                admit = _get_sharded_admit_wave(
+                    self._cache_flat_sh, self._cache_treedef,
+                    self._logits_sh
+                )
+            else:
+                admit = _admit_wave_jit
+            self.cache, self.logits = admit(
+                self.cache, self.logits, rows_arr, wave_cache["k"],
+                wave_cache["v"], wave_cache["length"], wave_logits,
+            )
         for i, req, row in good:
             row_hidden = (wave_hidden[i:i + 1]
                           if wave_hidden is not None else None)
@@ -3871,6 +4561,25 @@ class ContinuousBatcher:
             nbytes = bucket * self._kv_pos_bytes
             if pc.budget and nbytes > pc.budget:
                 continue  # would be refused: skip the device copy outright
+            if self._paged:
+                # Paged insert-on-prefill is ZERO-COPY: the entry ALIASES
+                # the admitting row's block run over [0, bucket) — one
+                # incref, no device slice. Positions < hlen are append-
+                # only (never rewritten); the creator's own writes above
+                # hlen in the tail block are masked from every consumer
+                # (entry readers pin length = hlen), the same pad rule
+                # the dense entry snapshot carries.
+                nblk = bucket // self._kv_block_size
+                run = (req.kv_blocks_aliased + req.kv_blocks_owned)[:nblk]
+                if len(run) < nblk:
+                    continue  # reservation shorter than the head bucket
+                self._pool.incref(run)
+                if not pc.insert(_PrefixEntry(
+                        ids=hid, pixels_key=pk, has_event=has_ev,
+                        kv=None, blocks=run, length=hlen, bucket=bucket,
+                        nbytes=nbytes)):
+                    self._pool.decref(run)
+                continue
             k, v = self._slice_prefix(row_cache, bucket, src_row)
             pc.insert(_PrefixEntry(
                 ids=hid, pixels_key=pk, has_event=has_ev,
@@ -3907,15 +4616,35 @@ class ContinuousBatcher:
             self._finish_forced(req, STATUS_NAN)
             return
         self._insert_prefix_on_prefill(req, row_cache)
-        if self.mesh is not None:
-            admit = _get_sharded_admit(
-                self._cache_flat_sh, self._cache_treedef, self._logits_sh
+        if self._paged:
+            rk = row_cache["k"]
+            s1 = (rk["q"] if isinstance(rk, dict) else rk).shape[2]
+            dst = jnp.asarray(self._paged_dst_blocks(req, s1))
+            btr = jnp.asarray(self._paged_bt_row(req))
+            if self.mesh is not None:
+                dst = self._serving.replicate(dst, self.mesh)
+                btr = self._serving.replicate(btr, self.mesh)
+                admit = _get_sharded_admit_paged(
+                    self._cache_flat_sh, self._cache_treedef,
+                    self._logits_sh)
+            else:
+                admit = _admit_row_paged_jit
+            self.cache, self.logits = admit(
+                self.cache, self.logits, row, dst, btr, row_cache,
+                row_logits
             )
+            req.kv_bt_written = True
         else:
-            admit = _admit_row_jit
-        self.cache, self.logits = admit(
-            self.cache, self.logits, row, row_cache, row_logits
-        )
+            if self.mesh is not None:
+                admit = _get_sharded_admit(
+                    self._cache_flat_sh, self._cache_treedef,
+                    self._logits_sh
+                )
+            else:
+                admit = _admit_row_jit
+            self.cache, self.logits = admit(
+                self.cache, self.logits, row, row_cache, row_logits
+            )
         obs_journey.event(self._journey_owner, req.rid, "admit",
                           path=path, row=row)
         self._activate_row(req, row, prompt_len, row_logits, row_hidden,

@@ -155,9 +155,13 @@ def _trace_identity(rec: Dict[str, Any]) -> Optional[Tuple]:
     # tok_s with an unpaired note. (The in-process --fleet key stays
     # OUT of the identity on purpose: thread replicas share one
     # runtime, and the fleet-vs-single tok_s gate is load-bearing.)
+    # kv_layout joins it too (ISSUE 12): the paged pool's block-table
+    # gather is a real per-token cost, so dense-vs-paged tok_s measures
+    # the layout, not drift — those records drop tok_s with an unpaired
+    # note. Records predating the key are dense by construction.
     return (r.get("requests"), r.get("seed"), r.get("arrival"),
             r.get("sessions"), r["output_min"], r["output_max"],
-            r.get("proc_fleet"))
+            r.get("proc_fleet"), r.get("kv_layout") or "dense")
 
 
 def compare(base: Dict[str, Any], new: Dict[str, Any],
@@ -190,8 +194,14 @@ def compare(base: Dict[str, Any], new: Dict[str, Any],
     # point's ledger peak covers N resident caches, a single-engine
     # point's covers one — cross-topology "regressions" there would be
     # architecture, not drift. Same design as the tok_s identity rule.
-    bt = (_unwrap(base).get("fleet"), _unwrap(base).get("proc_fleet"))
-    nt = (_unwrap(new).get("fleet"), _unwrap(new).get("proc_fleet"))
+    # kv_layout joins the topology (ISSUE 12): a paged point's resident
+    # bytes live in kv_pool/kv_block_table where a dense point's live in
+    # kv_cache — cross-layout memory deltas are the layout change
+    # itself, not drift.
+    bt = (_unwrap(base).get("fleet"), _unwrap(base).get("proc_fleet"),
+          _unwrap(base).get("kv_layout") or "dense")
+    nt = (_unwrap(new).get("fleet"), _unwrap(new).get("proc_fleet"),
+          _unwrap(new).get("kv_layout") or "dense")
     if bt != nt:
         dropped = sorted(k for k in set(b) | set(n)
                          if "mem_peak" in k or ".memory." in k

@@ -67,7 +67,13 @@ def test_workload_artifacts_schema():
             assert isinstance(leg.get("mem_peak_bytes"), int) \
                 and leg["mem_peak_bytes"] > 0, (p, "mem_peak_bytes")
             mem = leg["memory"]
-            assert mem["components"].get("kv_cache", 0) > 0, p
+            if rec.get("kv_layout") == "paged":
+                # Paged layout (ISSUE 12): the resident KV lives in the
+                # kv_pool + kv_block_table split instead of kv_cache.
+                assert mem["components"].get("kv_pool", 0) > 0, p
+                assert mem["components"].get("kv_block_table", 0) > 0, p
+            else:
+                assert mem["components"].get("kv_cache", 0) > 0, p
             assert mem["reconcile"]["live_bytes"] > 0, p
             assert len(leg["classes"]) >= 2, \
                 f"{p}: need >= 2 SLO classes per point"
@@ -325,6 +331,35 @@ def test_compare_bench_requires_miss_cause_breakdown_on_workload_legs():
             c["queue_p99_s"] = max(c["queue_p99_s"] * 10, 1.0)
     regs, _ = mod.compare(rec, worse, require=("queue_p99_s",))
     assert any("queue_p99_s" in r for r in regs)
+
+
+def test_compare_bench_pairs_dense_vs_paged_workload_honestly():
+    """ISSUE 12 satellite: WORKLOAD_r02.json is the r01 trace replayed
+    on the paged block pool. Service-quality keys (goodput, SLO
+    attainment, miss causes) PAIR across layouts and are gated — the
+    layout must not degrade service — while tok_s and the memory keys
+    DROP with unpaired notes (kv_layout joins the trace identity and
+    the memory topology: the block-table gather is a real per-token
+    cost and the pool's resident split is the architecture change
+    itself, not drift)."""
+    mod = _compare_mod()
+    base = _load(os.path.join(ROOT, "WORKLOAD_r01.json"))
+    paged = _load(os.path.join(ROOT, "WORKLOAD_r02.json"))
+    assert paged.get("kv_layout") == "paged"
+    assert base.get("kv_layout") in (None, "dense")
+    # The paged record carries the block-pool pressure story per leg.
+    for leg in paged["sweep"]:
+        kb = leg["kv_blocks"]
+        assert kb["free_blocks"] + kb["used_blocks"] == kb["usable_blocks"]
+    require = ("goodput_rps", "slo_met_ratio", "miss_causes")
+    regs, notes = mod.compare(base, paged, require=require)
+    assert regs == [], f"paged layout regressed service-quality keys " \
+                       f"vs WORKLOAD_r01: {regs}"
+    assert any("unpaired" in n and "tok_s" in n for n in notes)
+    assert any("unpaired" in n and "memory" in n for n in notes)
+    # Requiring tok_s across layouts fails loudly as not-comparable.
+    regs, _ = mod.compare(base, paged, require=("tok_s",))
+    assert any("not comparable" in r for r in regs)
 
 
 def test_compare_bench_gates_checked_in_rounds():
