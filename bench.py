@@ -575,10 +575,11 @@ def run_serve(args):
     srv = ContinuousBatcher(
         params, cfg, max_batch=args.serve_batch,
         max_len=((prompt_len + args.decode_tokens
-                  + args.serve_spec + 128) // 128) * 128,
+                  + _spec_slack(args) + 128) // 128) * 128,
         chunk=args.serve_chunk, eos_token_id=None,
         kv_quant=args.kv == "int8",
         speculative=args.serve_spec,
+        spec_buckets=args.serve_spec_buckets or None,
         prefill_chunk=args.serve_prefill_chunk,
         first_chunk=args.serve_first_chunk or 0,
         pipeline=bool(args.serve_pipeline),
@@ -766,9 +767,11 @@ def run_serve(args):
         "prefill_chunk": args.serve_prefill_chunk,
         "kv_cache": args.kv,
         "speculative": args.serve_spec,
+        "spec_buckets": args.serve_spec_buckets or "",
         **({"spec_tokens_per_iteration":
-            round(srv.spec_tokens_per_iteration(), 2)}
-           if args.serve_spec else {}),
+            round(srv.spec_tokens_per_iteration(), 2),
+            **_spec_leg_columns(srv)}
+           if srv.speculative else {}),
         "quant": quant,
         "platform": platform,
         "telemetry": telemetry,
@@ -810,6 +813,31 @@ def run_serve(args):
         record["admission_observations"] = adm.get("count", 0)
     print(json.dumps(record))
     return record
+
+
+def _spec_slack(args):
+    """max_len slack for the largest speculation window a boundary can
+    select (submit() reserves 1 + spec_max slots past the budget)."""
+    buckets = [int(x) for x in
+               str(getattr(args, "serve_spec_buckets", "") or "").split(",")
+               if x.strip()]
+    return max([int(args.serve_spec)] + buckets + [0])
+
+
+def _spec_leg_columns(srv):
+    """Adaptive-speculation sweep-leg columns (ISSUE 13): shared by the
+    workload legs and the spec A/B record."""
+    st = srv.spec_stats()
+    out = {
+        "accepted_per_dispatch": st["accepted_per_dispatch"],
+        "spec_depth_mean": st["spec_depth_mean"],
+        "spec_masked_rows": st["masked_rows"],
+    }
+    ad = st.get("adaptive")
+    if ad is not None:
+        out["spec_accept_ema"] = ad.get("accept_ema") or 0.0
+        out["spec_switches"] = ad.get("switches", 0)
+    return out
 
 
 def run_workload(args):
@@ -883,15 +911,17 @@ def run_workload(args):
         return _run_workload_fleet(args, preset, cfg, platform, params,
                                    spec, trace)
 
-    # Size the server to the trace (speculative slack included), like
+    # Size the server to the trace (speculative slack included — the
+    # LARGEST adaptive bucket when --serve_spec_buckets is armed), like
     # submit() will re-validate per request.
     need = max(wl.cache_positions(r, cfg.num_event_tokens)
                + r.max_new_tokens for r in trace)
-    max_len = ((need + 1 + args.serve_spec + 127) // 128) * 128
+    max_len = ((need + 1 + _spec_slack(args) + 127) // 128) * 128
     srv = ContinuousBatcher(
         params, cfg, max_batch=args.serve_batch, max_len=max_len,
         chunk=args.serve_chunk, eos_token_id=None,
         kv_quant=args.kv == "int8", speculative=args.serve_spec,
+        spec_buckets=args.serve_spec_buckets or None,
         first_chunk=args.serve_first_chunk or 0,
         pipeline=bool(args.serve_pipeline),
         prefix_cache=bool(args.serve_prefix_cache),
@@ -992,6 +1022,11 @@ def run_workload(args):
             "admission_stall_s": round(srv.admission_s, 3),
             "mixed_boundaries": srv.mixed_boundaries,
             "mixed_zero_token_boundaries": srv.mixed_zero_harvests,
+            # Adaptive speculation (ISSUE 13): accepted tokens per
+            # segment DISPATCH is the first-class column — the number
+            # the 8x spec spread is decided by — plus the mean chosen
+            # window and the per-row mask count (informational).
+            **(_spec_leg_columns(srv) if srv.speculative else {}),
             # Memory ledger (ISSUE 9): per-point peak + component
             # breakdown + the accounted/unaccounted reconcile — the
             # bytes column of the goodput story.
@@ -1133,6 +1168,7 @@ def run_workload(args):
         **({"ab": ab} if ab is not None else {}),
         "kv_cache": args.kv,
         "speculative": args.serve_spec,
+        "spec_buckets": args.serve_spec_buckets or "",
         "quant": quant,
         "platform": platform,
         "telemetry": telemetry,
@@ -1141,6 +1177,202 @@ def run_workload(args):
     if args.workload_out:
         # The WORKLOAD_r0N.json artifact form (pretty-printed; the fast
         # tier schema-validates the checked-in copies).
+        with open(args.workload_out, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    return record
+
+
+def run_workload_spec(args):
+    """Adaptive-vs-fixed speculation A/B under workload replay (ISSUE 13
+    — THE judgment the tentpole is shipped on). Two model regimes over
+    the SAME seeded trace, each replayed at every load mult by a fixed-K
+    arm (``--spec_ab_fixed_k``) and an adaptive arm
+    (``--serve_spec_buckets``):
+
+      * **easy** — a zeroed weight tree decodes a constant chain, so
+        suffix-vote acceptance is ~1: the controller must HOLD the top
+        bucket and tie fixed-K (the honest negative if it only ties);
+      * **adversarial** — the random tiny tree's chains have ~zero
+        draft acceptance: fixed-K burns a K-wide verify per ~1 token
+        while the controller must back off toward the K=0 bucket and
+        STRICTLY beat fixed K (the acceptance criterion).
+
+    Chains must be byte-identical between the arms at every point —
+    verification makes any draft depth exact; depth is latency only.
+    Writes the WORKLOAD_SPEC_r0N.json artifact via --workload_out."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from eventgpt_tpu import workload as wl
+    from eventgpt_tpu.obs import metrics as obs_metrics
+    from eventgpt_tpu.serve import ContinuousBatcher
+
+    obs_metrics.configure(bool(args.serve_telemetry))
+    preset, cfg, platform = _resolve_preset(args)
+    dtype = jnp.bfloat16
+    quant = args.quant if preset in ("7b", "13b") else "bf16"
+    # The easy regime IS the bench tree: _build_params' synthetic
+    # weights decode a constant chain, so suffix-vote acceptance is ~1
+    # — the easiest possible draft traffic.
+    params_easy = _build_params(cfg, dtype, quant)
+    if isinstance(params_easy["llama"]["lm_head"], dict):
+        raise SystemExit("workload_spec needs an unquantized tree "
+                         "(run --preset tiny / --quant bf16)")
+    # The adversarial regime: a COUNTER model. Zeroed blocks pass the
+    # input embedding straight to the final norm, and lm_head is the
+    # (unit-normalized) embedding table rolled by one row — greedy
+    # argmax maps each token to its ring neighbor, so the chain walks
+    # the vocab monotonically and its continuation NEVER appears in the
+    # lookup context (no self-repetition, no cross-request echo):
+    # suffix-vote acceptance is exactly zero, the worst case for a
+    # fixed wide window and precisely the traffic adaptive depth must
+    # survive by backing off.
+    emb = jax.random.normal(
+        jax.random.PRNGKey(13),
+        params_easy["llama"]["embed_tokens"].shape, jnp.float32)
+    emb = emb / jnp.linalg.norm(emb, axis=-1, keepdims=True)
+    params = jax.tree_util.tree_map(jnp.zeros_like, params_easy)
+    params["llama"] = {
+        **params["llama"],
+        "embed_tokens": emb.astype(dtype),
+        "final_norm": jnp.ones_like(params_easy["llama"]["final_norm"]),
+        "lm_head": jnp.roll(emb, -1, axis=0).T.astype(
+            params_easy["llama"]["lm_head"].dtype),
+    }
+
+    spec = wl.WorkloadSpec(
+        seed=args.workload_seed, n_requests=args.workload_requests,
+        rate_rps=args.workload_rate, arrival=args.workload_arrival,
+        sessions=args.workload_sessions,
+        output_min=args.workload_output_min,
+        output_max=args.workload_output_max,
+        interactive_ttft_s=args.slo_ttft_s,
+        interactive_itl_s=args.slo_itl_s,
+        batch_latency_s=args.slo_latency_s,
+    )
+    trace = wl.generate_trace(spec)
+    buckets = args.serve_spec_buckets or "0,2,4,8"
+    fixed_k = int(args.spec_ab_fixed_k)
+    mults = [float(x) for x in args.workload_mults.split(",") if x]
+    spec_max = max([fixed_k] + [int(x) for x in buckets.split(",") if x])
+
+    shape = (cfg.num_event_frames, 3, cfg.vision.image_size,
+             cfg.vision.image_size)
+    pix_cache = {}
+
+    def pixels_for(r):
+        if r.pixels_seed not in pix_cache:
+            pix_cache[r.pixels_seed] = wl.stream_pixels(shape, r.pixels_seed)
+        return pix_cache[r.pixels_seed]
+
+    def slo_for(r):
+        return spec.slo_for(r.slo_class)
+
+    need = max(wl.cache_positions(r, cfg.num_event_tokens)
+               + r.max_new_tokens for r in trace)
+    max_len = ((need + 1 + spec_max + 127) // 128) * 128
+    plens = sorted({wl.cache_positions(r, cfg.num_event_tokens)
+                    for r in trace})
+
+    def run_arm(model_params, adaptive, mult):
+        """One replay leg. ``mult > 0`` is the open-loop paced form
+        (goodput under offered load); ``mult == 0`` is the UNPACED
+        throughput point — every request submitted at once, so tok_s
+        measures the server, not the arrival process (the paced points
+        on a tiny trace are arrival-bound and tie by construction)."""
+        srv = ContinuousBatcher(
+            model_params, cfg, max_batch=args.serve_batch,
+            max_len=max_len, chunk=args.serve_chunk, eos_token_id=None,
+            kv_quant=args.kv == "int8", speculative=fixed_k,
+            spec_buckets=(buckets if adaptive else None),
+            pipeline=bool(args.serve_pipeline),
+            prefix_cache=bool(args.serve_prefix_cache),
+            prefix_insert=bool(args.serve_cache_insert),
+            prefill_budget=int(args.serve_prefill_budget),
+        )
+        if args.warmup:
+            srv.warmup(prompt_lens=plens)
+            wl.replay(srv, trace, pixels_for=pixels_for, paced=False)
+        srv.reset_serving_stats()
+        res = wl.replay(srv, trace, pixels_for=pixels_for,
+                        rate_mult=mult or 1.0, paced=mult > 0,
+                        slo_for=slo_for)
+        st = srv.slo_stats()
+        met = sum(c["met"] for c in st["classes"].values())
+        fin = sum(c["finished"] for c in st["classes"].values())
+        toks = sum(len(v) for v in res["finished"].values())
+        leg = {
+            "rate_mult": mult,
+            "goodput_rps": round(met / res["duration_s"], 3),
+            "slo_met_ratio": round(met / max(fin, 1), 4),
+            "tok_s": round(toks / res["duration_s"], 2),
+            "duration_s": round(res["duration_s"], 3),
+            **_spec_leg_columns(srv),
+        }
+        # Chains keyed by trace index (fresh servers hand out the same
+        # rids in submission order; the map makes that explicit).
+        chains = {int(i): res["finished"][rid]
+                  for i, rid in res["rids"].items()
+                  if rid in res["finished"]}
+        return leg, chains
+
+    legs = {}
+    chains_identical = True
+    # The paced mults judge goodput under offered load; the trailing
+    # rate_mult-0 point is the UNPACED throughput leg where the verify
+    # width's compute cost is actually visible (the strict
+    # adaptive-beats-fixed gate lives there).
+    mults = mults + [0.0]
+    for regime, model_params in (("easy", params_easy),
+                                 ("adversarial", params)):
+        fixed_sweep, adaptive_sweep = [], []
+        for mult in mults:
+            f_leg, f_chains = run_arm(model_params, False, mult)
+            a_leg, a_chains = run_arm(model_params, True, mult)
+            same = f_chains == a_chains
+            chains_identical &= same
+            f_leg["chains_identical"] = a_leg["chains_identical"] = same
+            fixed_sweep.append(f_leg)
+            adaptive_sweep.append(a_leg)
+            sys.stderr.write(
+                f"workload_spec {regime} x{mult}: fixed tok_s "
+                f"{f_leg['tok_s']} vs adaptive {a_leg['tok_s']} "
+                f"(depth_mean {a_leg['spec_depth_mean']}, chains "
+                f"{'==' if same else '!='})\n")
+        legs[regime] = {"fixed": {"sweep": fixed_sweep},
+                        "adaptive": {"sweep": adaptive_sweep}}
+
+    # Headline: adaptive-over-fixed tok/s ratio on the adversarial
+    # trace at the highest load point (the 8x-spread recovery).
+    adv_f = legs["adversarial"]["fixed"]["sweep"][-1]["tok_s"]
+    adv_a = legs["adversarial"]["adaptive"]["sweep"][-1]["tok_s"]
+    record = {
+        "metric": f"workload_spec_ab_{preset}",
+        "value": round(adv_a / max(adv_f, 1e-9), 3),
+        "unit": "x (adaptive/fixed tok_s, adversarial leg)",
+        "requests": len(trace),
+        "seed": spec.seed,
+        "arrival": spec.arrival,
+        "sessions": spec.sessions,
+        "output_min": spec.output_min,
+        "output_max": spec.output_max,
+        "trace_output_tokens": sum(r.max_new_tokens for r in trace),
+        "rate_rps": spec.rate_rps,
+        "max_batch": args.serve_batch,
+        "chunk": args.serve_chunk,
+        "fixed_k": fixed_k,
+        "spec_buckets": buckets,
+        "chains_identical": chains_identical,
+        "legs": legs,
+        "warmup": bool(args.warmup),
+        "quant": quant,
+        "platform": platform,
+    }
+    print(json.dumps(record))
+    if args.workload_out:
         with open(args.workload_out, "w") as f:
             json.dump(record, f, indent=2)
             f.write("\n")
@@ -1922,6 +2154,16 @@ def run_train(args):
         cfg = dataclasses.replace(
             cfg, llama=dataclasses.replace(cfg.llama,
                                            remat=args.remat == "on"))
+    if args.remat_policy != cfg.llama.remat_policy:
+        # Remat-policy sweep plumbing (ISSUE 13 satellite): the stage-2
+        # step's jax.checkpoint policy as a bench axis, so the
+        # full / dots_saveable / nothing_saveable sweep can run on
+        # hardware with one flag flip per leg.
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg, llama=dataclasses.replace(cfg.llama,
+                                           remat_policy=args.remat_policy))
     dtype = jnp.bfloat16
 
     # QLoRA-style stage 2 by default at 7B: int8 frozen base + apply-form
@@ -1972,6 +2214,7 @@ def run_train(args):
         "lora_r": args.lora_r,
         "quant": quant,
         "remat": cfg.llama.remat,
+        "remat_policy": cfg.llama.remat_policy,
         "tokens_per_s": round(tokens_per_step / dt, 1),
         "model_tflops_per_step": round(flops["total"] / 1e12, 2),
         "loss_finite": bool(np.isfinite(float(_sync(metrics["loss"])))),
@@ -1989,16 +2232,25 @@ def run_train_sweep(args):
     JSON line with the grid and the best throughput config."""
     points = []
     best = None
-    for remat in ("on", "off"):
+    # Remat axes (ISSUE 13 satellite): remat-on runs once per requested
+    # checkpoint POLICY (--remat_policy picks one; full remat is the
+    # r4-era behavior), remat-off stays the OOM-probing endpoint. The
+    # hardware sweep flips --remat_policy per leg to fill the
+    # full / dots_saveable middle ground VERDICT r5 flagged.
+    remat_axes = [("on", args.remat_policy), ("off", None)]
+    for remat, policy in remat_axes:
         for seq in (704, 1408):
             for batch in (1, 2, 4, 8):
                 leg_args = ["--mode", "train", "--preset", args.preset,
                             "--quant", args.quant, "--steps", str(args.steps),
                             "--seq", str(seq), "--batch", str(batch),
                             "--lora_r", str(args.lora_r), "--remat", remat]
+                if policy is not None:
+                    leg_args += ["--remat_policy", policy]
                 try:
                     r = _leg(leg_args, timeout=2400)
                     pt = {"batch": batch, "seq": seq, "remat": remat == "on",
+                          "remat_policy": policy,
                           "step_s": r["value"],
                           "tokens_per_s": r["tokens_per_s"],
                           "mfu": r.get("mfu")}
@@ -2007,6 +2259,7 @@ def run_train_sweep(args):
                 except Exception as e:
                     msg = str(e)[-200:]
                     pt = {"batch": batch, "seq": seq, "remat": remat == "on",
+                          "remat_policy": policy,
                           "oom_or_error": msg}
                 points.append(pt)
                 sys.stderr.write(f"train_sweep point {pt}\n")
@@ -2303,7 +2556,7 @@ def main() -> None:
     p.add_argument("--mode", default="all",
                    choices=["all", "decode", "train", "train_sweep",
                             "warm_probe", "spec", "serve", "stream",
-                            "workload"])
+                            "workload", "workload_spec"])
     # -- trace-driven workload replay (ISSUE 6) --
     p.add_argument("--workload_requests", type=int, default=32,
                    help="mode=workload: requests in the generated trace")
@@ -2380,6 +2633,14 @@ def main() -> None:
                    help="decode segment length for mode=serve")
     p.add_argument("--serve_spec", type=int, default=0,
                    help="speculative window for mode=serve (0 = plain)")
+    p.add_argument("--serve_spec_buckets", default="",
+                   help="adaptive speculation buckets for mode=serve/"
+                        "workload/workload_spec (ISSUE 13), e.g. "
+                        "'0,2,4,8'; empty = fixed --serve_spec")
+    p.add_argument("--spec_ab_fixed_k", type=int, default=8,
+                   help="mode=workload_spec: the fixed window the "
+                        "adaptive arm is judged against (the adversarial "
+                        "leg must strictly beat it)")
     p.add_argument("--serve_prefill_chunk", type=int, default=0,
                    help="decode-interleaved admission prefill chunk for "
                         "mode=serve (0 = one-shot prefill)")
@@ -2445,6 +2706,12 @@ def main() -> None:
     p.add_argument("--seq", type=int, default=704)
     p.add_argument("--steps", type=int, default=4)
     p.add_argument("--lora_r", type=int, default=16)
+    p.add_argument("--remat_policy", default="full",
+                   choices=["full", "nothing_saveable", "dots_saveable",
+                            "dots_with_no_batch_dims_saveable"],
+                   help="jax.checkpoint policy for mode=train (ISSUE 13 "
+                        "satellite): what the backward pass may SAVE "
+                        "instead of recomputing (full = save nothing)")
     p.add_argument("--remat", default="default", choices=["default", "on", "off"],
                    help="override cfg.llama.remat for mode=train (default: "
                         "the config's value, True at 7B)")
@@ -2476,6 +2743,8 @@ def main() -> None:
         run_serve(args)
     elif args.mode == "workload":
         run_workload(args)
+    elif args.mode == "workload_spec":
+        run_workload_spec(args)
     elif args.mode == "stream":
         run_stream(args)
     else:

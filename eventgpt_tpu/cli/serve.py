@@ -415,7 +415,12 @@ class ServingEngine:
             "memory": (b.memory_summary()
                        if hasattr(b, "memory_summary") else {}),
             **({"spec_tokens_per_iteration":
-                round(b.spec_tokens_per_iteration(), 2)}
+                round(b.spec_tokens_per_iteration(), 2),
+                # Adaptive speculation (ISSUE 13): accepted tokens per
+                # dispatch, mean chosen window, controller EMA + masked
+                # rows — the /stats face of egpt_serve_spec_*.
+                "spec": b.spec_stats() if hasattr(b, "spec_stats")
+                else {}}
                if b.speculative else {}),
             # reversed() on a dict view walks newest-first without
             # materializing the (bounded-at-8192) stats map each step.
@@ -1218,6 +1223,19 @@ def _worker_argv(args) -> list:
             "--slo_window", str(getattr(args, "slo_window", 256)),
             "--journey_keep", str(getattr(args, "journey_keep", 512)),
             ]
+    if getattr(args, "spec_buckets", None):
+        # Adaptive speculation (ISSUE 13): workers run their own
+        # controllers — the policy flags cross the process boundary
+        # like every other batcher-shaping flag.
+        argv += ["--spec_buckets", str(args.spec_buckets),
+                 "--spec_ema_alpha", str(getattr(args, "spec_ema_alpha",
+                                                 0.3)),
+                 "--spec_draft_cost", str(getattr(args, "spec_draft_cost",
+                                                  0.05)),
+                 "--spec_row_window", str(getattr(args, "spec_row_window",
+                                                  4)),
+                 "--spec_head_min_yield",
+                 str(getattr(args, "spec_head_min_yield", 0.05))]
     if getattr(args, "tokenizer_path", None):
         argv += ["--tokenizer_path", args.tokenizer_path]
     if getattr(args, "draft_head", None):
@@ -1369,6 +1387,13 @@ def build_engine(args, force_single: bool = False):
             # + used-token admission; "dense" is the A/B escape hatch.
             kv_layout=getattr(args, "kv_layout", "dense"),
             kv_pool_blocks=int(getattr(args, "kv_pool_blocks", 0)),
+            # Adaptive speculation (ISSUE 13): empty = fixed-K serving.
+            spec_buckets=getattr(args, "spec_buckets", None) or None,
+            spec_ema_alpha=float(getattr(args, "spec_ema_alpha", 0.3)),
+            spec_draft_cost=float(getattr(args, "spec_draft_cost", 0.05)),
+            spec_row_window=int(getattr(args, "spec_row_window", 4)),
+            spec_head_min_yield=float(
+                getattr(args, "spec_head_min_yield", 0.05)),
         )
 
     def _make_engine(batcher, hb_dir):
@@ -1510,9 +1535,31 @@ def main(argv=None):
                         "expected USED tokens, not worst case — "
                         "GET /memory's kv_blocks shows live pressure")
     p.add_argument("--speculative", type=int, default=0)
+    p.add_argument("--spec_buckets", default="",
+                   help="adaptive speculation (ISSUE 13): comma-separated "
+                        "draft-window buckets, e.g. '0,2,4,8' (0 = the "
+                        "draft-free fallback segment). Each dispatch "
+                        "boundary selects one precompiled bucket from the "
+                        "measured acceptance EMA and masks low-acceptance "
+                        "rows' drafts; --speculative becomes the default/"
+                        "fault-degradation window (max bucket when 0). "
+                        "Empty = fixed-K serving")
+    p.add_argument("--spec_ema_alpha", type=float, default=0.3,
+                   help="acceptance-EMA step per harvested segment")
+    p.add_argument("--spec_draft_cost", type=float, default=0.05,
+                   help="relative marginal verify cost per draft position "
+                        "(the controller's cost model: ~0 when decode is "
+                        "weight-streaming bound, higher on small models)")
+    p.add_argument("--spec_row_window", type=int, default=4,
+                   help="per-row acceptance window (segments) behind the "
+                        "per-row draft-depth mask")
+    p.add_argument("--spec_head_min_yield", type=float, default=0.05,
+                   help="prune draft heads/lookup levels whose realized "
+                        "yield EMA falls below this")
     p.add_argument("--draft_head", default=None,
                    help="trained Medusa head stack (.npz) for speculative "
-                        "drafting (requires --speculative > 0)")
+                        "drafting (requires --speculative > 0 or "
+                        "--spec_buckets)")
     p.add_argument("--prefill_chunk", type=int, default=0)
     p.add_argument("--prefill_budget", type=int, default=-1,
                    help="stall-free admission (ISSUE 5): prompt tokens "

@@ -75,10 +75,30 @@ class LlamaConfig:
     # full dequantized/flash-residual copies of the weight set (measured
     # 16.9G of HLO temps on v5e) and cannot fit one chip.
     remat: bool = True
+    # Remat POLICY (ISSUE 13 satellite, VERDICT r5 / ROADMAP item 4's
+    # enabler): what jax.checkpoint may SAVE instead of recomputing in
+    # the backward pass. "full" = save nothing, recompute everything
+    # (the pre-sweep behavior; jax's default policy, so it is
+    # operationally identical to "nothing_saveable" — kept as two
+    # spellings because the sweep reports the literal policy it ran).
+    # "dots_saveable" saves matmul outputs — the middle ground between
+    # full remat's ~19 TFLOP/step of recompute at 7B stage-2 and
+    # remat-off's OOM. Only meaningful under grad with remat=True.
+    remat_policy: str = "full"
 
     _ATTN_IMPLS = ("dense", "flash", "ring", "ulysses")
+    _REMAT_POLICIES = ("full", "nothing_saveable", "dots_saveable",
+                       "dots_with_no_batch_dims_saveable")
 
     def __post_init__(self):
+        if self.remat_policy not in self._REMAT_POLICIES:
+            # llama.prefill maps this string onto jax.checkpoint_policies;
+            # a typo would silently fall back to full remat and the sweep
+            # would report a policy it never ran.
+            raise ValueError(
+                f"remat_policy must be one of {self._REMAT_POLICIES}, "
+                f"got {self.remat_policy!r}"
+            )
         if self.attn_impl not in self._ATTN_IMPLS:
             # llama.prefill dispatches on this string and treats anything
             # unrecognized as dense — a typo would silently drop flash or

@@ -41,7 +41,10 @@ segment still in flight, the window the engine's abort/restart path must
 survive; ``serve.mixed_dispatch`` fires at the piggyback lane-advance
 boundary of a mixed segment — the batcher degrades that boundary to a
 plain decode dispatch and re-queues the admitting lanes, decode rows
-untouched), ``serve.prefix_copy`` (prefix-cache entry copy at admission),
+untouched; ``serve.spec_adapt`` fires at the adaptive-speculation
+boundary decision — the controller degrades THAT boundary to the fixed
+default window at full depth, chains untouched),
+``serve.prefix_copy`` (prefix-cache entry copy at admission),
 ``serve.loop`` (``ServingEngine`` scheduler thread), ``fleet.route`` /
 ``fleet.probe`` / ``fleet.replica_kill`` (``fleet.Fleet``: a route fault
 degrades that submit to least-queue routing, a probe fault marks the

@@ -672,6 +672,15 @@ def _spec_draft_verify(
     drafts_in=None,  # (B, W-1) drafts carried from the previous window
                      # (Medusa mode: heads ran at the last correction's
                      # hidden state, one iteration ago)
+    depth=None,      # optional (B,) int32 per-row draft-depth cap
+                     # (ISSUE 13): draft positions >= depth[r] are masked
+                     # to the -1 unmatchable filler, capping row r's
+                     # effective window at depth[r]+1 committed tokens
+                     # per verify WITHOUT a new executable. Exact by the
+                     # same rule that makes drafts exact: a masked draft
+                     # is simply never accepted (greedy: -1 != argmax;
+                     # sampled: d_valid gates acceptance), so the chain
+                     # is byte-identical at any mask. None = full depth.
 ):
     """THE speculative draft-and-verify step, shared by the one-shot loop
     (``_spec_loop_jit``) and the serving segment
@@ -706,6 +715,13 @@ def _spec_draft_verify(
         drafts = drafts_in
     else:
         drafts = _suffix_vote_drafts(params, ids_buf, pos, window, history)
+    if depth is not None and window > 1:
+        # Per-row depth mask (ISSUE 13): positions past the row's cap
+        # become the unmatchable filler — acceptance stops there, the
+        # correction token still comes from logits that only attended
+        # to accepted (target-equal) positions, so the commit is exact.
+        drafts = jnp.where(
+            jnp.arange(window - 1)[None, :] < depth[:, None], drafts, -1)
 
     wtoks = jnp.concatenate([c0[:, None], drafts], axis=1)  # (B, W)
     prev_len = cache["length"]

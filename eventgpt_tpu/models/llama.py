@@ -97,6 +97,22 @@ def embed_tokens(params: Params, input_ids: jnp.ndarray) -> jnp.ndarray:
     return params["embed_tokens"][input_ids]
 
 
+def _remat_policy(cfg: LlamaConfig):
+    """Map ``cfg.remat_policy`` onto a ``jax.checkpoint`` policy (ISSUE
+    13 satellite — the stage-2 remat sweep). "full" is jax's default
+    (save nothing, recompute every layer activation — the pre-sweep
+    behavior, byte-identical HLO to passing no policy at all);
+    "nothing_saveable" is the same semantics via the explicit policy
+    object; "dots_saveable" (and the no-batch-dims variant) save matmul
+    outputs, trading HBM for the ~19 TFLOP/step of stage-2 recompute
+    full remat pays at 7B. Forward-only callers (serving) never hit the
+    policy: it only shapes the backward pass."""
+    name = getattr(cfg, "remat_policy", "full")
+    if name == "full":
+        return None
+    return getattr(jax.checkpoint_policies, name)
+
+
 def resize_token_embeddings(params: Params, new_vocab_size: int) -> Params:
     """Grow embed/lm_head rows, initializing new rows to the mean of old ones.
 
@@ -480,7 +496,9 @@ def prefill(
         h_out = h_mid + _mlp_block(y2, layer)
         return h_out, (k, v)
 
-    block_fn = jax.checkpoint(block, prevent_cse=False) if cfg.remat else block
+    block_fn = (jax.checkpoint(block, prevent_cse=False,
+                               policy=_remat_policy(cfg))
+                if cfg.remat else block)
     x, (k_all, v_all) = lax.scan(block_fn, x, (params["layers"],))
 
     # In-place slot write (aliases the donated cache buffers; jnp.pad here

@@ -27,7 +27,7 @@ Training (``train/medusa.py``) freezes the whole model and fits only the
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -52,36 +52,45 @@ def num_draft_heads(medusa: MedusaParams) -> int:
     return int(medusa["w"].shape[0])
 
 
-def medusa_hidden(medusa: MedusaParams, x: jnp.ndarray) -> jnp.ndarray:
+def medusa_hidden(medusa: MedusaParams, x: jnp.ndarray,
+                  k: Optional[int] = None) -> jnp.ndarray:
     """(..., D) -> (..., K, D): h_k = x + silu(x @ w_k) — all heads in one
-    stacked einsum (a single (K*D, D)-shaped MXU contraction)."""
-    proj = jnp.einsum("...d,kde->...ke", x, medusa["w"].astype(x.dtype))
+    stacked einsum (a single (K*D, D)-shaped MXU contraction). ``k``
+    statically prunes the head stack to the first k heads BEFORE the
+    einsum (ISSUE 13 head pruning: a smaller speculation bucket's
+    executable must not pay the pruned heads' matmul + lm_head at every
+    verify; None = all heads, the training/eval form)."""
+    w = medusa["w"] if k is None else medusa["w"][:k]
+    proj = jnp.einsum("...d,kde->...ke", x, w.astype(x.dtype))
     return x[..., None, :] + jax.nn.silu(proj)
 
 
 def medusa_logits(
-    llama_params: Any, medusa: MedusaParams, x: jnp.ndarray
+    llama_params: Any, medusa: MedusaParams, x: jnp.ndarray,
+    k: Optional[int] = None,
 ) -> jnp.ndarray:
     """(..., D) -> (..., K, V) f32 through the frozen (possibly quantized)
     lm_head. Head k's logits score the token at stream offset k+2 from
     the position whose hidden is ``x`` (offset +1 is the base lm_head's
-    own prediction)."""
-    return _mm_f32(medusa_hidden(medusa, x), llama_params["lm_head"])
+    own prediction). ``k`` prunes the stack (see ``medusa_hidden``)."""
+    return _mm_f32(medusa_hidden(medusa, x, k), llama_params["lm_head"])
 
 
 def medusa_drafts(
     llama_params: Any, medusa: MedusaParams, x: jnp.ndarray, k: int
 ) -> jnp.ndarray:
     """Greedy drafts for the next verification window: (B, D) -> (B, k)
-    int32 (argmax per head, truncated/validated to k heads)."""
+    int32 (argmax per head, truncated/validated to k heads). The
+    truncation happens in the HEAD STACK (``medusa_hidden``), so a
+    window-W speculation bucket only computes its W-1 heads."""
     n = num_draft_heads(medusa)
     if k > n:
         raise ValueError(
             f"window needs {k} drafts but the Medusa stack has {n} heads "
             f"(train with num_heads >= window - 1)"
         )
-    logits = medusa_logits(llama_params, medusa, x)  # (B, K, V)
-    return jnp.argmax(logits[:, :k], axis=-1).astype(jnp.int32)
+    logits = medusa_logits(llama_params, medusa, x, k)  # (B, k, V)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
 def medusa_loss(

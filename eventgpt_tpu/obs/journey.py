@@ -67,6 +67,10 @@ from typing import Any, Dict, List, Optional, Tuple
 #                   (path = full | wave | suffix | suffix_wave | chunk | lane)
 #   segment         one harvest committed tokens to this row
 #                   (count + tokens per BOUNDARY, never per step)
+#   spec_depth      the adaptive speculation controller SWITCHED this
+#                   row's dispatch-boundary window (ISSUE 13; emitted on
+#                   change only, to every live row — same-kind merge
+#                   keeps it bounded)
 #   shed            the fleet router refused the request (policy shed)
 #   route           the fleet router placed the request on a replica
 #   repin           failover moved the session's affinity pin
@@ -80,7 +84,8 @@ from typing import Any, Dict, List, Optional, Tuple
 #   finish          terminal bookkeeping (status + slo_met)
 EVENT_KINDS = (
     "submit", "queue", "prefix", "mem_guard_defer", "kv_block_defer",
-    "lane_join", "lane_finish", "admit", "segment", "shed", "route",
+    "lane_join", "lane_finish", "admit", "segment", "spec_depth", "shed",
+    "route",
     "repin", "failover", "worker_lost", "respawn", "nan_quarantine",
     "deadline", "cancel", "exported", "finish",
 )

@@ -74,7 +74,8 @@ METRIC_LABELS = {
                  "procfleet.rpc", "procfleet.spawn",
                  "procfleet.worker_kill", "serve.admit",
                  "serve.dispatch", "serve.loop", "serve.mem_guard",
-                 "serve.mixed_dispatch", "serve.prefix_copy", "serve.step",
+                 "serve.mixed_dispatch", "serve.prefix_copy",
+                 "serve.spec_adapt", "serve.step",
                  "train.step", "other"),
         "kind": ("fail", "delay"),
     },
@@ -562,6 +563,25 @@ SERVE_MIXED_PREFILL_TOKENS = REGISTRY.counter(
     "egpt_serve_mixed_prefill_tokens_total",
     "Prompt positions prefilled inside mixed segments (piggyback lanes), "
     "bounded per boundary by --prefill_budget")
+# -- adaptive speculation (ISSUE 13, eventgpt_tpu/serve.py +
+#    eventgpt_tpu/serve_spec.py) --
+SERVE_SPEC_DEPTH = REGISTRY.histogram(
+    "egpt_serve_spec_depth",
+    "Speculation window selected per dispatch boundary by the adaptive "
+    "controller (--spec_buckets; 1 = the draft-free fallback segment, "
+    "the K=0 bucket). Constant at the fixed K without buckets",
+    ROWS_BUCKETS)
+SERVE_SPEC_ACCEPT = REGISTRY.gauge(
+    "egpt_serve_spec_accept_ratio",
+    "Controller acceptance EMA: accepted draft positions / offered "
+    "draft positions across harvested verifies (the depth-selection "
+    "signal; 0 until the first drafted verify lands)")
+SERVE_SPEC_MASKED = REGISTRY.counter(
+    "egpt_serve_spec_masked_rows",
+    "Rows whose per-row draft depth was masked below the selected "
+    "bucket's full depth, summed over dispatch boundaries (per-row "
+    "windowed acceptance undershot the bucket, or a pruned head/level "
+    "capped it)")
 # -- SLO classes + goodput (ISSUE 6, eventgpt_tpu/serve.py) --
 SERVE_SLO_REQUESTS = REGISTRY.counter(
     "egpt_serve_slo_requests_total",

@@ -101,6 +101,7 @@ from jax import lax
 
 from eventgpt_tpu import faults
 from eventgpt_tpu import serve_blocks
+from eventgpt_tpu import serve_spec
 from eventgpt_tpu.config import EventChatConfig
 from eventgpt_tpu.obs import journey as obs_journey
 from eventgpt_tpu.obs import memory as obs_memory
@@ -592,7 +593,14 @@ def _spec_segment(
     top_p: float = 1.0,
     history=None,     # (H,) server-wide served-text lookup buffer
     medusa=None,      # trained draft heads (models/medusa.py)
-    drafts=None,      # (B, W-1) per-row carried drafts (Medusa mode)
+    drafts=None,      # (B, >=W-1) per-row carried drafts (Medusa mode);
+                      # may be WIDER than this window (the adaptive
+                      # server keeps one (B, max_window-1) resident
+                      # buffer across buckets — only the first W-1
+                      # columns are consumed/updated, the rest pass
+                      # through untouched)
+    depth=None,       # (B,) int32 per-row draft-depth cap (ISSUE 13);
+                      # None = full depth (the fixed-K server)
 ):
     """``n_iters`` speculative verify iterations over the shared batch —
     the serving form of ``models/eventchat._spec_loop_jit`` (same
@@ -612,39 +620,69 @@ def _spec_segment(
     row is ``done`` only when its EOS lands within that cap.
 
     Returns (ids_buf, n_new (B,), done (B,), cache, key, drafts,
-    n_iters_run, frozen_out, n_rem_out, base_pos_out) — ``n_iters_run``
+    n_iters_run, frozen_out, n_rem_out, base_pos_out, row_acc (B,),
+    row_off (B,), pos_acc (W-1,), pos_off (W-1,)) — ``n_iters_run``
     is the executed iteration count, so the server can report REALIZED
     acceptance (committed tokens per verify iteration) on live traffic
-    instead of inferring it; the last three are the next segment's
-    device-resident control state (the same bookkeeping the host harvest
-    applies), so the pipelined scheduler can dispatch segment N+1 before
-    fetching segment N.
+    instead of inferring it; ``frozen_out``/``n_rem_out``/``base_pos_out``
+    are the next segment's device-resident control state (the same
+    bookkeeping the host harvest applies), so the pipelined scheduler can
+    dispatch segment N+1 before fetching segment N. The trailing four are
+    the adaptive controller's food (ISSUE 13), all UNCAPPED acceptance
+    (budget caps are scheduling, not draft quality): per-row accepted /
+    offered draft counts over the segment, and the same split per draft
+    POSITION — realized per-head yield for Medusa pruning, per-level
+    yield for the lookup chain.
     """
     from eventgpt_tpu.models.eventchat import _spec_draft_verify
 
     b, s_ids = ids_buf.shape
     bidx = jnp.arange(b)
     iarr = jnp.arange(window)[None, :]
+    d_w = max(window - 1, 0)
+    iarr1 = jnp.arange(d_w)[None, :]
     eos = eos_token_id
     if drafts is None:
-        drafts = jnp.zeros((b, max(window - 1, 0)), jnp.int32)
+        drafts = jnp.zeros((b, d_w), jnp.int32)
 
     def cond(state):
-        it, _, n_new, done, _, _, _ = state
+        it, _, n_new, done = state[:4]
         live = ~(frozen | done) & (n_new < n_rem)
         return (it < n_iters) & live.any()
 
     def body(state):
-        it, ids_buf, n_new, done, cache, key, drafts = state
+        (it, ids_buf, n_new, done, cache, key, drafts,
+         row_acc, row_off, pos_acc, pos_off) = state
         active = ~(frozen | done) & (n_new < n_rem)
         pos = base_pos + n_new
-        commit, m_count, first_eos, hit, cache, key, drafts = (
+        # The adaptive server's resident draft buffer is max_window
+        # wide; this bucket consumes/updates only its first W-1 columns
+        # (static slice — identity when the widths match).
+        drafts_w = drafts[:, :d_w]
+        commit, m_count, first_eos, hit, cache, key, drafts_w = (
             _spec_draft_verify(
                 params, cfg, ids_buf, pos, cache, key, window,
                 temperature, top_p, eos, history=history,
-                medusa=medusa, drafts_in=drafts,
+                medusa=medusa, drafts_in=drafts_w, depth=depth,
             )
         )
+        drafts = drafts.at[:, :d_w].set(drafts_w)
+        # Acceptance accounting (ISSUE 13): accepted = m_count - 1
+        # (the correction token is not a draft), offered = the row's
+        # effective depth this verify — both UNCAPPED by budget.
+        offered = (jnp.minimum(depth, d_w) if depth is not None
+                   else jnp.full((b,), d_w, jnp.int32))
+        offered = jnp.where(active, offered, 0)
+        acc_i = jnp.where(active, m_count - 1, 0)
+        row_acc = row_acc + acc_i
+        row_off = row_off + offered
+        if d_w:
+            pos_acc = pos_acc + (
+                (iarr1 < acc_i[:, None]) & active[:, None]
+            ).astype(jnp.int32).sum(axis=0)
+            pos_off = pos_off + (
+                (iarr1 < offered[:, None]) & active[:, None]
+            ).astype(jnp.int32).sum(axis=0)
         # Unlike the one-shot loop, commits are CAPPED at the remaining
         # budget (the row may be harvested right after this segment) and a
         # row is done only when its EOS lands within the cap.
@@ -659,12 +697,16 @@ def _spec_segment(
         n_new = n_new + m_eff
         done = done | (active & hit & (first_eos + 1 <= cap))
         cache = {**cache, "length": cache["length"] + m_eff}
-        return it + 1, ids_buf, n_new, done, cache, key, drafts
+        return (it + 1, ids_buf, n_new, done, cache, key, drafts,
+                row_acc, row_off, pos_acc, pos_off)
 
-    it, ids_buf, n_new, done, cache, key, drafts = lax.while_loop(
+    (it, ids_buf, n_new, done, cache, key, drafts,
+     row_acc, row_off, pos_acc, pos_off) = lax.while_loop(
         cond, body,
         (jnp.int32(0), ids_buf, jnp.zeros((b,), jnp.int32),
-         jnp.zeros((b,), bool), cache, key, drafts),
+         jnp.zeros((b,), bool), cache, key, drafts,
+         jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+         jnp.zeros((d_w,), jnp.int32), jnp.zeros((d_w,), jnp.int32)),
     )
     # Device-resident scheduler carry (see _decode_segment): the
     # speculative path's NaN gate is the admission check, so the carry is
@@ -674,7 +716,8 @@ def _spec_segment(
     n_rem_out = jnp.where(frozen_out, 0, n_rem_out)
     base_pos_out = base_pos + n_new
     return (ids_buf, n_new, done, cache, key, drafts, it,
-            frozen_out, n_rem_out, base_pos_out)
+            frozen_out, n_rem_out, base_pos_out,
+            row_acc, row_off, pos_acc, pos_off)
 
 
 _spec_segment_jit = functools.partial(
@@ -979,7 +1022,7 @@ def _mixed_spec_segment(
     n_rem, lane_embeds, lane_cache, lane_start, lane_new_len,
     lane_last_idx, n_iters: int, window: int, chunk_p: int,
     eos_token_id: int, temperature: float = 0.0, top_p: float = 1.0,
-    history=None, medusa=None, drafts=None,
+    history=None, medusa=None, drafts=None, depth=None,
 ):
     """Mixed segment, speculative form: ``_spec_segment`` + the
     piggybacked prefill lanes in one dispatch (see
@@ -987,7 +1030,7 @@ def _mixed_spec_segment(
     spec = _spec_segment(
         params, cfg, cache, key, ids_buf, base_pos, frozen, n_rem,
         n_iters, window, eos_token_id, temperature, top_p,
-        history=history, medusa=medusa, drafts=drafts,
+        history=history, medusa=medusa, drafts=drafts, depth=depth,
     )
     lane = _lane_advance(
         params, cfg, lane_embeds, lane_cache, lane_start, lane_new_len,
@@ -1179,17 +1222,20 @@ def _get_sharded_spec_segment(
     )
     return jax.jit(
         lambda params, cache, key, ids_buf, base_pos, frozen, n_rem, history,
-        medusa, drafts:
+        medusa, drafts, depth=None:
         _spec_segment(
             params, cfg, cache, key, ids_buf, base_pos, frozen, n_rem,
             n_iters, window, eos_token_id, temperature, top_p,
-            history=history, medusa=medusa, drafts=drafts,
+            history=history, medusa=medusa, drafts=drafts, depth=depth,
         ),
         donate_argnums=(1,),
-        # Trailing (b_sh, b_sh, b_sh): the pipelined carry pins
-        # (frozen_out, n_rem_out, base_pos_out) — see the decode variant.
+        # (b_sh, b_sh, b_sh) after it: the pipelined carry pins
+        # (frozen_out, n_rem_out, base_pos_out) — see the decode
+        # variant. Trailing: acceptance accounting (row_* batch-placed,
+        # pos_* replicated — ISSUE 13).
         out_shardings=(ids_sh, b_sh, b_sh, cache_sh, key_sh, drafts_sh,
-                       scalar_sh, b_sh, b_sh, b_sh),
+                       scalar_sh, b_sh, b_sh, b_sh,
+                       b_sh, b_sh, scalar_sh, scalar_sh),
     )
 
 
@@ -1339,17 +1385,18 @@ def _get_sharded_mixed_spec_segment(
     return jax.jit(
         lambda params, cache, key, ids_buf, base_pos, frozen, n_rem,
         history, medusa, drafts, lane_embeds, lane_cache, lane_start,
-        lane_new_len, lane_last_idx:
+        lane_new_len, lane_last_idx, depth=None:
         _mixed_spec_segment(
             params, cfg, cache, key, ids_buf, base_pos, frozen, n_rem,
             lane_embeds, lane_cache, lane_start, lane_new_len,
             lane_last_idx, n_iters, window, chunk_p, eos_token_id,
             temperature, top_p, history=history, medusa=medusa,
-            drafts=drafts,
+            drafts=drafts, depth=depth,
         ),
         donate_argnums=(1, 11),
         out_shardings=(ids_sh, b_sh, b_sh, cache_sh, key_sh, drafts_sh,
                        scalar_sh, b_sh, b_sh, b_sh,
+                       b_sh, b_sh, scalar_sh, scalar_sh,
                        lane_last_sh, lane_hidden_sh, lane_sh),
     )
 
@@ -1528,6 +1575,12 @@ class ContinuousBatcher:
         mem_capacity_bytes: int = 0,
         kv_layout: str = "dense",
         kv_pool_blocks: int = 0,
+        spec_buckets=None,
+        spec_ema_alpha: float = 0.3,
+        spec_draft_cost: float = 0.05,
+        spec_hysteresis: float = 0.05,
+        spec_row_window: int = 4,
+        spec_head_min_yield: float = 0.05,
     ):
         if prefill_chunk and (2 * SEQ_BUCKET) % prefill_chunk:
             # A chunk that does not divide the bucket grain would force
@@ -1573,7 +1626,7 @@ class ContinuousBatcher:
         # than compile a ramp executable no segment can ever select.
         self.first_chunk = (
             min(int(first_chunk), chunk)
-            if first_chunk and not speculative else 0
+            if first_chunk and not speculative and not spec_buckets else 0
         )
         self.temperature, self.top_p = float(temperature), float(top_p)
         self.eos = eos_token_id if eos_token_id is not None else -1
@@ -1632,6 +1685,40 @@ class ContinuousBatcher:
         # admission (the _spec_segment_jit invariant) so no logits state
         # carries between segments.
         self.speculative = int(speculative)
+        # Adaptive speculation (ISSUE 13 tentpole): ``spec_buckets``
+        # (e.g. "0,2,4,8") makes the verification window a PER-DISPATCH-
+        # BOUNDARY decision — the jax-free ``serve_spec.SpecController``
+        # tracks the realized acceptance EMA + per-row windows the
+        # harvest feeds it, and each boundary selects one precompiled
+        # bucket executable (K=0 -> the draft-free window-1 segment, so
+        # pathological traffic degrades to baseline cost) plus a per-row
+        # draft-depth mask. ``speculative`` becomes the DEFAULT window
+        # (the fault-degradation bucket; max bucket when 0). Chains are
+        # byte-identical to any fixed K — verification makes every
+        # draft exact, depth only moves latency.
+        _buckets = serve_spec.parse_spec_buckets(spec_buckets) \
+            if isinstance(spec_buckets, (str, type(None))) \
+            else tuple(sorted({max(int(k), 1) for k in spec_buckets}))
+        self._spec_ctl: Optional[serve_spec.SpecController] = None
+        self.spec_windows: Optional[tuple] = None
+        if _buckets:
+            if not self.speculative:
+                self.speculative = max(_buckets)
+            self._spec_ctl = serve_spec.SpecController(
+                _buckets, default_window=self.speculative,
+                ema_alpha=spec_ema_alpha, draft_cost=spec_draft_cost,
+                hysteresis=spec_hysteresis, row_window=spec_row_window,
+                head_min_yield=spec_head_min_yield,
+                # The mixed-boundary draft budget is the SAME token
+                # budget lane admission enforces (ISSUE 5): drafts and
+                # piggybacked prefill compete for boundary latency.
+                draft_budget=max(int(prefill_budget), 0),
+            )
+            self.spec_windows = self._spec_ctl.windows
+        # Buffer/slack sizing bound: the largest window any boundary can
+        # select (== speculative for the fixed-K server).
+        self.spec_max = (self._spec_ctl.max_window if self._spec_ctl
+                         else self.speculative)
         self.draft_head = draft_head
         if draft_head is not None:
             if not self.speculative:
@@ -1642,21 +1729,26 @@ class ContinuousBatcher:
             from eventgpt_tpu.models.medusa import num_draft_heads
 
             n_heads = num_draft_heads(draft_head)
-            if n_heads < self.speculative - 1:
+            if n_heads < self.spec_max - 1:
                 # Validate at construction: the first medusa_drafts call
                 # otherwise raises at ADMISSION time, tearing down the
                 # serving loop mid-drain (the submit()-validation rule).
+                # Adaptive serving seeds/carries max_window-1 drafts.
                 raise ValueError(
-                    f"draft_head has {n_heads} heads but speculative="
-                    f"{self.speculative} needs {self.speculative - 1}"
+                    f"draft_head has {n_heads} heads but the largest "
+                    f"speculation window {self.spec_max} needs "
+                    f"{self.spec_max - 1}"
                 )
         if self.speculative:
             self.ids_buf = jnp.full((max_batch, self.max_len), -1, jnp.int32)
             self.base_pos = np.zeros((max_batch,), np.int64)
             # Per-row carried drafts (consumed only in Medusa mode; a
             # zeros dummy otherwise keeps the segment signature uniform).
+            # Sized to the LARGEST bucket — every bucket's executable
+            # consumes/updates its first W-1 columns of the same
+            # resident buffer (no per-switch reshape, no extra dispatch).
             self.spec_drafts = jnp.zeros(
-                (max_batch, max(self.speculative - 1, 0)), jnp.int32
+                (max_batch, max(self.spec_max - 1, 0)), jnp.int32
             )
         # Server-wide served-text history: a chronological buffer of prompt
         # text + committed answers across ALL requests, used as extra
@@ -1845,6 +1937,9 @@ class ContinuousBatcher:
         # Compiled-footprint probe result (warmup() fills it; lazily
         # probed on first memory_stats() otherwise).
         self._compiled_footprint: Optional[Dict[str, Any]] = None
+        # Last chosen speculation window (journey spec_depth events fire
+        # on CHANGE only; persists across reset_serving_stats).
+        self._spec_last_window = self.speculative
         self.reset_serving_stats()
 
     def __del__(self):
@@ -2056,15 +2151,24 @@ class ContinuousBatcher:
                 self.mesh, self.max_batch, *warm_carry
             ))
         chunks = [None] + ([self.first_chunk] if self.first_chunk else [])
+        # Adaptive speculation (ISSUE 13): every bucket in the window
+        # set is its own (n_iters, window)-keyed executable — prime
+        # them ALL here, so a mid-serve depth switch NEVER compiles
+        # (the no-new-compilation contract tests/test_spec_adaptive
+        # pins via the jit cache size).
+        windows = (list(self.spec_windows) if self.spec_windows
+                   else [None])
         for ck in chunks:
-            # The TTFT-ramp segment is its own executable (chunk is a
-            # static arg) — warm it too or the first admission pays it.
-            rec = self._dispatch_segment(
-                chunk=ck, carry=tuple(warm_carry), record_carry=False,
-                probe_faults=False,
-            )
-            jax.block_until_ready(rec["n_new"])
-            n += 1
+            for w in windows:
+                # The TTFT-ramp segment is its own executable (chunk is
+                # a static arg) — warm it too or the first admission
+                # pays it.
+                rec = self._dispatch_segment(
+                    chunk=ck, carry=tuple(warm_carry), record_carry=False,
+                    probe_faults=False, window=w,
+                )
+                jax.block_until_ready(rec["n_new"])
+                n += 1
         if self.prefill_budget:
             # Mixed-segment executables (ISSUE 5): idle lanes against the
             # largest requested prompt bucket — the decode half exits at
@@ -2072,12 +2176,14 @@ class ContinuousBatcher:
             # (masked); nothing touches resident rows.
             self._ensure_lane_buffers(buckets[-1])
             for ck in chunks:
-                rec = self._dispatch_segment(
-                    chunk=ck, carry=tuple(warm_carry), record_carry=False,
-                    probe_faults=False, warm_mixed=True,
-                )
-                jax.block_until_ready(rec["n_new"])
-                n += 1
+                for w in windows:
+                    rec = self._dispatch_segment(
+                        chunk=ck, carry=tuple(warm_carry),
+                        record_carry=False, probe_faults=False,
+                        warm_mixed=True, window=w,
+                    )
+                    jax.block_until_ready(rec["n_new"])
+                    n += 1
         self._dev_carry = None
         if self._prefix_cache is not None and self._prefix_cache.n_entries:
             # Prefix-admission (suffix) executables, one per distinct
@@ -2515,7 +2621,7 @@ class ContinuousBatcher:
             n_text + self.cfg.num_event_tokens, self.cfg.llama.max_seq_len
         )
         # Speculative rows write one verify window past their last commit.
-        slack = 1 + self.speculative
+        slack = 1 + self.spec_max
         if prompt_len + max_new_tokens + slack > self.max_len:
             raise ValueError(
                 f"request does not fit: prompt {prompt_len} + budget "
@@ -2652,6 +2758,8 @@ class ContinuousBatcher:
                 req.prefix_entry = None
             if req.deadline is not None:
                 self._n_deadlines -= 1
+            if self._spec_ctl is not None:
+                self._spec_ctl.forget(req.rid)
             obs_trace.async_end(req.phase, req.rid, status="exported")
             # The request is not over, it is MOVING: close this
             # replica's timeline as "exported" (a journey-only
@@ -2815,9 +2923,16 @@ class ContinuousBatcher:
             n_iters = max(1, self.chunk // self.speculative)
             history = (jnp.asarray(self._history.astype(np.int32))
                        if self._history is not None else None)
+            # Adaptive servers probe the executable the live traffic
+            # actually runs — depth array included (fixed-K probes the
+            # depth-less trace, same as before ISSUE 13).
+            probe_depth = (jnp.zeros((self.max_batch,), jnp.int32)
+                           if self._spec_ctl is not None else None)
             if self.mesh is not None:
                 if history is not None:
                     history = self._serving.replicate(history, self.mesh)
+                if probe_depth is not None:
+                    probe_depth = jax.device_put(probe_depth, self._b_sh)
                 fn = _get_sharded_spec_segment(
                     self.cfg, n_iters, self.speculative, int(self.eos),
                     self.temperature, self.top_p, self._cache_flat_sh,
@@ -2827,7 +2942,7 @@ class ContinuousBatcher:
                 stats = obs_memory.compiled_stats(
                     fn, self.params, self.cache, self.key, self.ids_buf,
                     base_pos, frozen, n_rem, history, self.draft_head,
-                    self.spec_drafts,
+                    self.spec_drafts, probe_depth,
                 )
             else:
                 stats = obs_memory.compiled_stats(
@@ -2836,6 +2951,7 @@ class ContinuousBatcher:
                     n_iters, self.speculative, int(self.eos),
                     self.temperature, self.top_p, history=history,
                     medusa=self.draft_head, drafts=self.spec_drafts,
+                    depth=probe_depth,
                 )
         elif self.mesh is not None:
             fn = _get_sharded_decode_segment(
@@ -2888,6 +3004,26 @@ class ContinuousBatcher:
         active). THE definition; /stats and the bench both read it here."""
         return self.spec_tokens / max(self.spec_iterations, 1)
 
+    def spec_stats(self) -> Dict[str, Any]:
+        """Adaptive-speculation snapshot (ISSUE 13): the bench columns
+        (accepted tokens per dispatch, mean chosen window, masked rows)
+        plus the controller's own state. Host-side counters — available
+        with telemetry disarmed, the prefix-cache counter convention."""
+        out: Dict[str, Any] = {
+            "speculative": self.speculative,
+            "accepted_per_dispatch": round(
+                self.spec_tokens / max(self.spec_dispatches, 1), 3),
+            "spec_depth_mean": round(
+                self.spec_depth_sum / max(self.spec_dispatches, 1), 3),
+            "masked_rows": self.spec_masked_rows,
+            "dispatches": self.spec_dispatches,
+            "tokens_per_iteration": round(
+                self.spec_tokens_per_iteration(), 3),
+        }
+        if self._spec_ctl is not None:
+            out["adaptive"] = self._spec_ctl.stats()
+        return out
+
     def reset_serving_stats(self) -> None:
         """Zero the phase-scoped counters (admission stalls, speculative
         acceptance, pipeline overlap) — e.g. after warmup or an unmeasured
@@ -2896,6 +3032,16 @@ class ContinuousBatcher:
         self.admission_max_s = 0.0
         self.spec_iterations = 0
         self.spec_tokens = 0
+        # Adaptive speculation (ISSUE 13), phase-scoped like the
+        # acceptance counters above: dispatches + chosen-window sum
+        # (their ratio is the bench's spec_depth_mean), rows masked
+        # below full depth, and the bounded chosen-window trace the
+        # replay-determinism test compares run-to-run. Controller EMA
+        # state is NOT reset — it is live policy, not a statistic.
+        self.spec_dispatches = 0
+        self.spec_depth_sum = 0
+        self.spec_masked_rows = 0
+        self.spec_depth_trace: deque = deque(maxlen=4096)
         # Pipeline overlap accounting (all host-observable, definitions in
         # PERFORMANCE.md "Pipelined scheduling"):
         #   device_segment_s  — host time BLOCKED waiting on the device
@@ -3100,10 +3246,79 @@ class ContinuousBatcher:
                 if self.rows[r] is req and not self.frozen[r]:
                     self._finish_row(r, status=STATUS_DEADLINE)
 
+    def _spec_boundary(self, forced: Optional[int] = None,
+                       mixed: bool = False, record: bool = True):
+        """Resolve this dispatch boundary's speculation window and
+        per-row draft-depth mask (ISSUE 13). Fixed-K servers (no
+        ``spec_buckets``) return (K, None) — the pre-adaptive
+        executables, unchanged. Adaptive servers consult the
+        ``SpecController`` (or honor ``forced`` — warmup priming a
+        specific bucket) and ALWAYS return a depth array, so every
+        boundary runs the same executable signature the warmup
+        compiled. The ``serve.spec_adapt`` fault site fires here: a
+        trip degrades THIS boundary to the fixed default window at
+        full depth — adaptive policy off for one boundary, service
+        untouched (chaos-tested)."""
+        if self._spec_ctl is None:
+            w = forced if forced is not None else self.speculative
+            if record:
+                # Fixed-K boundaries count too: accepted-per-dispatch /
+                # depth-mean columns must be comparable across the
+                # adaptive-vs-fixed A/B.
+                self.spec_dispatches += 1
+                self.spec_depth_sum += w
+                self.spec_depth_trace.append(w)
+            return w, None
+        ctl = self._spec_ctl
+        w = forced
+        depths = None
+        masked = 0
+        if w is None:
+            try:
+                faults.maybe_fail("serve.spec_adapt")
+                faults.maybe_delay("serve.spec_adapt")
+                live = sum(1 for r, req in enumerate(self.rows)
+                           if req is not None and not self.frozen[r])
+                w = ctl.select_window(live_rows=live, mixed=mixed)
+                depths, masked = ctl.depths(
+                    [req.rid if req is not None else None
+                     for req in self.rows], w)
+            except faults.InjectedFault:
+                w = ctl.default_window
+                depths = None
+                masked = 0
+        if depths is None:
+            depths = [w - 1] * self.max_batch
+        # depths is a host-built policy list — the comprehension keeps
+        # that visible to the hot-sync lint (no device value in sight).
+        depth = jnp.asarray(np.asarray([int(d) for d in depths], np.int32))
+        if self.mesh is not None:
+            depth = jax.device_put(depth, self._b_sh)
+        if record:
+            self.spec_dispatches += 1
+            self.spec_depth_sum += w
+            self.spec_masked_rows += masked
+            self.spec_depth_trace.append(w)
+            obs_metrics.SERVE_SPEC_DEPTH.observe(w)
+            if masked:
+                obs_metrics.SERVE_SPEC_MASKED.inc(masked)
+            if w != self._spec_last_window:
+                # Depth SWITCH: stamp every live row's timeline (the
+                # requests whose latency the new bucket shapes);
+                # same-kind merge keeps the journey bounded.
+                self._spec_last_window = w
+                for r, req in enumerate(self.rows):
+                    if req is not None and not self.frozen[r]:
+                        obs_journey.event(
+                            self._journey_owner, req.rid, "spec_depth",
+                            window=w)
+        return w, depth
+
     def _dispatch_segment(self, chunk: Optional[int] = None, carry=None,
                           record_carry: bool = True,
                           probe_faults: bool = True,
-                          warm_mixed: bool = False) -> dict:
+                          warm_mixed: bool = False,
+                          window: Optional[int] = None) -> dict:
         """Dispatch one decode/spec segment on the resident state WITHOUT
         waiting for it, and advance the device-resident carry. Returns the
         in-flight record ``_harvest_segment`` consumes — every entry a
@@ -3120,7 +3335,9 @@ class ContinuousBatcher:
         ``serve.dispatch`` fault site there, so armed chaos plans count
         only scheduler dispatches. ``warm_mixed`` forces the MIXED
         executable with idle lanes (warmup's compile of the piggyback
-        path).
+        path). ``window`` forces a specific speculation bucket (warmup
+        priming every bucket's executable); None lets the adaptive
+        controller choose (ISSUE 13) — or uses the fixed K.
 
         With live piggyback lanes (ISSUE 5) the dispatch is a MIXED
         segment: the same decode/spec body plus every lane advancing
@@ -3169,6 +3386,14 @@ class ContinuousBatcher:
         if mixed:
             (lane_start, lane_new_len, lane_last_idx, lane_adv,
              lane_tok) = self._lane_args()
+        # Per-boundary speculation decision (ISSUE 13): window bucket +
+        # per-row depth mask, BEFORE the dispatch so the executable is
+        # picked host-side with zero device sync.
+        spec_w = spec_depth = None
+        if self.speculative:
+            spec_w, spec_depth = self._spec_boundary(
+                window, mixed=mixed and bool(self._lanes),
+                record=record_carry)
         rec = {"chunk": chunk, "frozen_in": frozen,
                "wait_at_dispatch": self.device_segment_s}
         if record_carry:
@@ -3183,7 +3408,7 @@ class ContinuousBatcher:
         _ann.__enter__()
         lane_out = None
         if self.speculative:
-            n_iters = max(1, chunk // self.speculative)
+            n_iters = max(1, chunk // spec_w)
             history = (jnp.asarray(self._history.astype(np.int32))
                        if self._history is not None else None)
             if self.mesh is not None:
@@ -3192,7 +3417,7 @@ class ContinuousBatcher:
                 if mixed:
                     last_sh, hidden_sh = self._suffix_wave_sh(self._lane_cap)
                     fn = _get_sharded_mixed_spec_segment(
-                        self.cfg, n_iters, self.speculative,
+                        self.cfg, n_iters, spec_w,
                         self._lane_chunk, int(self.eos),
                         self.temperature, self.top_p,
                         self._cache_flat_sh, self._cache_treedef,
@@ -3203,16 +3428,17 @@ class ContinuousBatcher:
                     )
                     (self.ids_buf, n_new, done, self.cache, self.key,
                      self.spec_drafts, it, frozen_out, n_rem_out,
-                     base_pos_out, *lane_out) = fn(
+                     base_pos_out, row_acc, row_off, pos_acc, pos_off,
+                     *lane_out) = fn(
                         self.params, self.cache, self.key, self.ids_buf,
                         base_pos, frozen, n_rem, history, self.draft_head,
                         self.spec_drafts, self._lane_embeds,
                         self._lane_cache, lane_start, lane_new_len,
-                        lane_last_idx,
+                        lane_last_idx, spec_depth,
                     )
                 else:
                     fn = _get_sharded_spec_segment(
-                        self.cfg, n_iters, self.speculative, int(self.eos),
+                        self.cfg, n_iters, spec_w, int(self.eos),
                         self.temperature, self.top_p,
                         self._cache_flat_sh, self._cache_treedef,
                         self._ids_sh, self._b_sh, self._key_sh,
@@ -3220,37 +3446,39 @@ class ContinuousBatcher:
                     )
                     (self.ids_buf, n_new, done, self.cache, self.key,
                      self.spec_drafts, it, frozen_out, n_rem_out,
-                     base_pos_out) = fn(
+                     base_pos_out, row_acc, row_off, pos_acc,
+                     pos_off) = fn(
                         self.params, self.cache, self.key, self.ids_buf,
                         base_pos, frozen, n_rem, history, self.draft_head,
-                        self.spec_drafts,
+                        self.spec_drafts, spec_depth,
                     )
             elif mixed:
                 (self.ids_buf, n_new, done, self.cache, self.key,
                  self.spec_drafts, it, frozen_out, n_rem_out,
-                 base_pos_out, *lane_out) = (
+                 base_pos_out, row_acc, row_off, pos_acc, pos_off,
+                 *lane_out) = (
                     _mixed_spec_segment_jit(
                         self.params, self.cfg, self.cache, self.key,
                         self.ids_buf, base_pos, frozen, n_rem,
                         self._lane_embeds, self._lane_cache, lane_start,
                         lane_new_len, lane_last_idx, n_iters,
-                        self.speculative, self._lane_chunk,
+                        spec_w, self._lane_chunk,
                         int(self.eos), self.temperature, self.top_p,
                         history=history, medusa=self.draft_head,
-                        drafts=self.spec_drafts,
+                        drafts=self.spec_drafts, depth=spec_depth,
                     )
                 )
             else:
                 (self.ids_buf, n_new, done, self.cache, self.key,
                  self.spec_drafts, it, frozen_out, n_rem_out,
-                 base_pos_out) = (
+                 base_pos_out, row_acc, row_off, pos_acc, pos_off) = (
                     _spec_segment_jit(
                         self.params, self.cfg, self.cache, self.key,
                         self.ids_buf, base_pos,
-                        frozen, n_rem, n_iters, self.speculative,
+                        frozen, n_rem, n_iters, spec_w,
                         int(self.eos), self.temperature, self.top_p,
                         history=history, medusa=self.draft_head,
-                        drafts=self.spec_drafts,
+                        drafts=self.spec_drafts, depth=spec_depth,
                     )
                 )
             # Read back only the window a segment could have written
@@ -3258,10 +3486,12 @@ class ContinuousBatcher:
             # the whole (B, max_len) buffer. The gather runs on the
             # OUTPUT ids_buf at the PRE-segment base — enqueued now, so
             # the harvest is one device_get with no extra dispatch.
-            width = max(chunk, self.speculative)
+            width = max(chunk, spec_w)
             rec.update(
                 gather=_gather_new_jit(self.ids_buf, base_pos, width),
-                it=it, n_new=n_new, done=done,
+                it=it, n_new=n_new, done=done, window=spec_w,
+                row_acc=row_acc, row_off=row_off,
+                pos_acc=pos_acc, pos_off=pos_off,
             )
         else:
             if self.mesh is not None:
@@ -3363,9 +3593,11 @@ class ContinuousBatcher:
             self.host_gap_s += gap
             obs_metrics.SERVE_HOST_GAP.inc(gap)
         if self.speculative:
-            new_np, it_v, n_new, done, frozen_in = jax.device_get(
+            (new_np, it_v, n_new, done, frozen_in, row_acc, row_off,
+             pos_acc, pos_off) = jax.device_get(
                 (rec["gather"], rec["it"], rec["n_new"], rec["done"],
-                 rec["frozen_in"])
+                 rec["frozen_in"], rec["row_acc"], rec["row_off"],
+                 rec["pos_acc"], rec["pos_off"])
             )
             new_np = np.asarray(new_np)
             tokens = None
@@ -3405,6 +3637,24 @@ class ContinuousBatcher:
         if self.speculative:
             self.spec_iterations += int(it_v)
             self.spec_tokens += int(n_new.sum())
+            if self._spec_ctl is not None:
+                # Feed the controller the segment's UNCAPPED acceptance
+                # (per-row and per-position) — the depth policy for the
+                # NEXT boundary; in pipelined mode one boundary of lag,
+                # deterministically (the choice for N+1 was already made
+                # at its dispatch).
+                r_acc = np.asarray(row_acc)
+                r_off = np.asarray(row_off)
+                f_in = np.asarray(frozen_in)
+                self._spec_ctl.observe(
+                    [(req.rid, int(r_acc[r]), int(r_off[r]))
+                     for r, req in enumerate(self.rows)
+                     if req is not None and not f_in[r]],
+                    [int(x) for x in np.asarray(pos_acc)],
+                    [int(x) for x in np.asarray(pos_off)],
+                )
+                obs_metrics.SERVE_SPEC_ACCEPT.set(
+                    self._spec_ctl.accept_ema or 0.0)
         n_new = np.asarray(n_new)
         done = np.asarray(done)
         frozen_in = np.asarray(frozen_in)
@@ -3501,6 +3751,10 @@ class ContinuousBatcher:
             req.prefix_entry = None
         if req.deadline is not None:
             self._n_deadlines -= 1
+        if self._spec_ctl is not None:
+            # Drop the per-row acceptance window on every terminal path
+            # (the controller's host state must not grow per request).
+            self._spec_ctl.forget(req.rid)
         ids = req.tokens
         if (self.eos_token_id is not None and ids
                 and ids[-1] == self.eos_token_id):
@@ -3845,7 +4099,7 @@ class ContinuousBatcher:
         grain = 2 * SEQ_BUCKET
         bucket = min(((prompt_len + grain - 1) // grain) * grain,
                      self.max_len)
-        slack = 1 + self.speculative
+        slack = 1 + self.spec_max
         cover = min(max(bucket, prompt_len + max_new + slack), self.max_len)
         return self._pool.blocks_for(cover)
 
@@ -3898,7 +4152,7 @@ class ContinuousBatcher:
         full blocks below the divergence point on a prefix hit). False =
         pool cannot cover it right now — the caller re-queues the
         request (never a partial grant)."""
-        slack = 1 + self.speculative
+        slack = 1 + self.spec_max
         cover = min(max(s1, req.prompt_len + req.max_new_tokens + slack),
                     self.max_len)
         total = self._pool.blocks_for(cover)
@@ -4669,15 +4923,17 @@ class ContinuousBatcher:
         # speculative rows): the next dispatch re-uploads the host mirror.
         # _admit only runs drained, so the mirror is settled here.
         self._dev_carry = None
-        if self.draft_head is not None and self.speculative > 1:
+        if self.draft_head is not None and self.spec_max > 1:
             from eventgpt_tpu.models import medusa as medusa_mod
 
             # Seed the row's first draft window from the prompt's last
             # hidden (the heads at that position predict the tokens after
             # the prefill-argmax commit — the _spec_segment carry rule).
+            # The FULL max-window buffer is seeded: any bucket a later
+            # boundary selects finds its first W-1 columns fresh.
             row_drafts = medusa_mod.medusa_drafts(
                 self.params["llama"], self.draft_head, row_hidden,
-                self.speculative - 1,
+                self.spec_max - 1,
             )
             self.spec_drafts = self.spec_drafts.at[row].set(row_drafts[0])
             if self.mesh is not None:

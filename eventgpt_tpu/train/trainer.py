@@ -91,6 +91,17 @@ class Trainer:
             cfg = dataclasses.replace(
                 cfg, llama=dataclasses.replace(cfg.llama, attn_impl=train_args.attn_impl)
             )
+        if getattr(train_args, "remat_policy", "full") != \
+                cfg.llama.remat_policy:
+            # Stage-2 remat-policy sweep (ISSUE 13 satellite): thread the
+            # CLI choice into the config the train step closes over —
+            # LlamaConfig.__post_init__ validates the name.
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                cfg, llama=dataclasses.replace(
+                    cfg.llama, remat_policy=train_args.remat_policy)
+            )
         ctx = mesh.shape["context"]
         if ctx > 1 and cfg.llama.attn_impl not in ("ring", "ulysses"):
             raise ValueError(

@@ -61,7 +61,13 @@ DEFAULT_ABS_FLOOR = 0.002  # seconds-scale values below this compare equal
 # direction. Checked in order; first match wins.
 _HIGHER = ("tok_s", "tokens_per_s", "goodput", "attainment", "hit_ratio",
            "met_ratio", "overlap_ratio", "mfu", "tokens_per_iteration",
-           "goodput_ratio", "accounted_ratio")
+           "goodput_ratio", "accounted_ratio",
+           # Adaptive speculation (ISSUE 13): committed tokens per
+           # segment dispatch — the number the 8x spec spread is decided
+           # by. spec_depth_mean / spec_masked_rows / spec_accept_ema
+           # stay deliberately direction-less: a different chosen depth
+           # is a different policy, not a regression.
+           "accepted_per_dispatch")
 # Memory-ledger keys (ISSUE 9) gate lower-is-better: a grown resident
 # peak or a grown unaccounted share is a regression under the same
 # ±15% scheme (component echo keys carry no direction — informational).

@@ -362,6 +362,80 @@ def test_compare_bench_pairs_dense_vs_paged_workload_honestly():
     assert any("not comparable" in r for r in regs)
 
 
+def test_spec_ab_artifact_schema_and_acceptance():
+    """ISSUE 13 acceptance: the checked-in adaptive-vs-fixed workload
+    A/B (``WORKLOAD_SPEC_r0N.json``). Chains byte-identical between
+    the arms at every point; goodput/tok_s no worse than fixed-K on
+    the easy (high-acceptance) trace; STRICTLY better than fixed K on
+    the low-acceptance adversarial leg's server-bound (unpaced)
+    throughput point — the controller must have backed off."""
+    paths = sorted(glob.glob(os.path.join(ROOT, "WORKLOAD_SPEC_r0*.json")))
+    assert paths, "no WORKLOAD_SPEC_r0*.json checked in"
+    rec = _load(paths[-1])
+    assert rec["metric"].startswith("workload_spec_ab_")
+    assert rec["chains_identical"] is True
+    assert rec["fixed_k"] >= 2
+    assert rec["spec_buckets"]
+    # Trace identity keys ride along (the tok_s pairing contract).
+    for k in ("requests", "seed", "output_min", "output_max",
+              "trace_output_tokens"):
+        assert k in rec, k
+    for regime in ("easy", "adversarial"):
+        fixed = rec["legs"][regime]["fixed"]["sweep"]
+        adaptive = rec["legs"][regime]["adaptive"]["sweep"]
+        assert len(fixed) == len(adaptive) >= 3, regime
+        for f, a in zip(fixed, adaptive):
+            assert f["rate_mult"] == a["rate_mult"]
+            assert f["chains_identical"] and a["chains_identical"]
+            # The new first-class columns exist on every leg.
+            for k in ("accepted_per_dispatch", "spec_depth_mean",
+                      "spec_masked_rows", "tok_s", "goodput_rps"):
+                assert k in f and k in a, (regime, k)
+            # Adaptive is never worse than fixed beyond bench noise.
+            assert a["tok_s"] >= f["tok_s"] * 0.85, (regime, f, a)
+            assert a["goodput_rps"] >= f["goodput_rps"] * 0.85, \
+                (regime, f, a)
+    # The unpaced (rate_mult 0) throughput points carry the judgment:
+    # easy holds the top bucket (depth_mean == fixed_k), adversarial
+    # backs off (depth_mean < fixed_k) and STRICTLY beats fixed.
+    easy_a = rec["legs"]["easy"]["adaptive"]["sweep"][-1]
+    assert easy_a["rate_mult"] == 0.0
+    assert easy_a["spec_depth_mean"] == rec["fixed_k"], easy_a
+    adv_f = rec["legs"]["adversarial"]["fixed"]["sweep"][-1]
+    adv_a = rec["legs"]["adversarial"]["adaptive"]["sweep"][-1]
+    assert adv_a["spec_depth_mean"] < rec["fixed_k"], adv_a
+    assert adv_a["tok_s"] > adv_f["tok_s"], (adv_f, adv_a)
+    assert rec["value"] > 1.0  # the headline adaptive/fixed ratio
+
+
+def test_compare_bench_gates_spec_columns():
+    """accepted_per_dispatch is a gated higher-is-better key: a record
+    that loses acceptance per dispatch on the same trace fires; the
+    informational spec_depth_mean does not gate (a different chosen
+    depth is a different policy, not a regression)."""
+    mod = _compare_mod()
+    paths = sorted(glob.glob(os.path.join(ROOT, "WORKLOAD_r0*.json")))
+    rec = json.loads(json.dumps(_load(paths[0])))
+    for leg in rec["sweep"]:
+        leg["accepted_per_dispatch"] = 4.0
+        leg["spec_depth_mean"] = 8.0
+    regs, _ = mod.compare(rec, rec)
+    assert regs == []
+    worse = json.loads(json.dumps(rec))
+    for leg in worse["sweep"]:
+        leg["accepted_per_dispatch"] = 1.0
+        leg["spec_depth_mean"] = 1.0  # policy change: must NOT gate
+    regs, _ = mod.compare(rec, worse)
+    assert any("accepted_per_dispatch" in r for r in regs)
+    assert not any("spec_depth_mean" in r for r in regs)
+    # --require makes the column's absence loud.
+    gone = json.loads(json.dumps(rec))
+    for leg in gone["sweep"]:
+        del leg["accepted_per_dispatch"]
+    regs, _ = mod.compare(rec, gone, require=("accepted_per_dispatch",))
+    assert any("not comparable" in r for r in regs)
+
+
 def test_compare_bench_gates_checked_in_rounds():
     """Smoke the regression gate on two committed rounds: r04 -> r05 is
     a known-clean transition (it must pass), and the reverse direction
