@@ -840,6 +840,64 @@ def _spec_leg_columns(srv):
     return out
 
 
+def _series_arm_leg(telemetry: bool):
+    """Arm the time-series store for one workload leg (ISSUE 15):
+    sub-second cadence sized to CPU-backend leg durations (a x16 leg
+    lasts ~1 s), second-denominated fast/slow burn windows, and a
+    fresh ring + alert state per leg so the fired counts are
+    per-point. Returns the store (None disarmed)."""
+    from eventgpt_tpu.obs import series as obs_series
+
+    if not telemetry:
+        obs_series.disable()
+        return None
+    # Tight cadence + short windows (CPU legs last seconds, not
+    # minutes); the arrival gate swaps queue_trend's confirmation to
+    # offered-load pressure — on this trace a lone ~14-deep burst at
+    # x1 drains itself (EWMA ~27/s), while x16's recurring backlog
+    # rides ~100/s arrivals.
+    return obs_series.configure(
+        interval_s=0.05, keep=4096, autostart=True,
+        fast_window_s=0.25, slow_window_s=1.0,
+        slo_min_finished=8, queue_min=2.0, queue_arrival_min=60.0,
+        arm_samples=2, clear_samples=3)
+
+
+def _series_leg_columns(store, duration_s: float) -> dict:
+    """``leg["series"]`` (sampled timeline + whole-leg derivations) and
+    ``leg["alerts"]`` (per-rule fired counts + the per-point firing
+    log). Key names are deliberately outside compare_bench's direction
+    patterns except goodput_ratio_min, which gates higher-is-better on
+    purpose: a lower windowed-goodput floor under the same trace IS a
+    regression."""
+    from eventgpt_tpu.obs.series import ALERT_RULES
+
+    if store is None:
+        return {}
+    store.stop()  # freeze the ring before reading it
+    snap = store.snapshot(window_s=duration_s + 1.0, n=4096)
+    al = store.alerts_snapshot()
+    d = snap["derived"]
+    series = {
+        "interval_s": snap["interval_s"],
+        "samples": snap["samples"],
+        **{k: d[k] for k in ("request_rate_per_s", "token_rate_per_s",
+                             "submit_rate_per_s", "arrival_rate_ewma",
+                             "queue_depth_last", "queue_depth_max",
+                             "goodput_ratio_min") if k in d},
+        # The raw timeline (bounded): lists of dicts are flatten-inert
+        # in compare_bench — audit data, not a gated metric.
+        "points": snap["points"][-512:],
+    }
+    alerts = {
+        "fired": {r: al["rules"][r]["fired"] for r in ALERT_RULES},
+        "fired_total": sum(al["rules"][r]["fired"] for r in ALERT_RULES),
+        "active_end": al["active"],
+        "log": al["log"],
+    }
+    return {"series": series, "alerts": alerts}
+
+
 def run_workload(args):
     """Trace-driven workload replay (ISSUE 6): open-loop replay of a
     seeded traffic trace (``eventgpt_tpu/workload.py`` — bursty
@@ -972,6 +1030,11 @@ def run_workload(args):
         srv.reset_serving_stats()
         obs_metrics.REGISTRY.reset()
         obs_memory.LEDGER.reset_peak()  # per-point peak (ISSUE 9)
+        # Fresh series ring + alert state per point (ISSUE 15): the
+        # sampler thread runs through the replay, the alert evaluator
+        # fires on the transient saturation the end-state numbers
+        # cannot show (x16's queue build-up clears before the leg ends).
+        series_store = _series_arm_leg(telemetry)
         res = wl.replay(srv, trace, pixels_for=pixels_for,
                         rate_mult=mult, paced=True, slo_for=slo_for)
         st = srv.slo_stats()
@@ -1049,6 +1112,7 @@ def run_workload(args):
             leg["occupancy_mean"] = round(float(occ.get("mean", 0.0)), 2)
             adm = obs_metrics.SERVE_ADMISSION._summary()
             leg["admission_p50_s"] = adm.get("p50", 0.0)
+        leg.update(_series_leg_columns(series_store, res["duration_s"]))
         sweep.append(leg)
 
     ab = None
@@ -1084,6 +1148,10 @@ def run_workload(args):
                     obs_journey.configure(max(1024, 2 * len(trace)))
                 else:
                     obs_journey.disable()
+                # The series sampler rides the armed arm too (ISSUE 15):
+                # the A/B's chain-identity + <2% overhead contract now
+                # covers background sampling + alert evaluation.
+                _series_arm_leg(armed)
                 fresh_cache()
                 srv.reset_serving_stats()
                 t_cpu0 = time.process_time()
@@ -1102,6 +1170,7 @@ def run_workload(args):
         obs_metrics.configure(telemetry)
         if telemetry:
             obs_journey.configure(max(1024, 2 * len(trace)))
+        _series_arm_leg(telemetry)
         # PAIRED estimate on PROCESS CPU TIME: instrumentation cost is
         # host CPU work by construction (clock reads, lock'd dict
         # writes, journey appends), and on the CPU backend the model
@@ -1493,6 +1562,9 @@ def _run_workload_fleet(args, preset, cfg, platform, params, spec, trace):
     sweep = []
     for mult in mults:
         reset_point()
+        # One process-global series store senses the whole thread fleet
+        # (FLEET_QUEUE_DEPTH feeds queue_trend) — ISSUE 15.
+        series_store = _series_arm_leg(telemetry)
         res = replay(mult, paced=True)
         st = fleet.slo_stats()
         met_total = sum(c["met"] for c in st["classes"].values())
@@ -1584,6 +1656,7 @@ def _run_workload_fleet(args, preset, cfg, platform, params, spec, trace):
                    if k in ("total_bytes", "peak_bytes", "components")},
                 "reconcile": obs_memory.LEDGER.reconcile(),
             },
+            **_series_leg_columns(series_store, res["duration_s"]),
         })
 
     record = {
@@ -1748,6 +1821,10 @@ def _run_workload_procfleet(args, preset, cfg, platform, spec, trace):
     for mult in mults:
         fleet.reset_stats(
             clear_prefix_cache=bool(args.serve_cache_insert))
+        # Coordinator-side series store (ISSUE 15): senses arrivals at
+        # the router; workers carry their own stores behind the RPC
+        # seam (GET /series aggregates both).
+        series_store = _series_arm_leg(bool(args.serve_telemetry))
         res = replay(mult, paced=True)
         refresh_snapshots()
         st = fleet.slo_stats()
@@ -1835,6 +1912,7 @@ def _run_workload_procfleet(args, preset, cfg, platform, spec, trace):
             "memory": {"per_worker": [
                 {"worker": w["worker"],
                  "memory_bytes": w["memory_bytes"]} for w in workers]},
+            **_series_leg_columns(series_store, res["duration_s"]),
         })
 
     record = {

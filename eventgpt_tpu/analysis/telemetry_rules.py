@@ -280,6 +280,27 @@ def _journey_enums(sources) -> Dict[str, Tuple[tuple, str, int]]:
     return out
 
 
+def _series_enums(sources) -> Dict[str, Tuple[tuple, str, int]]:
+    """``ALERT_RULES`` from obs/series.py — a pure literal by contract
+    (ISSUE 15), read statically like METRIC_LABELS. Returns
+    name -> (tuple, rel, line)."""
+    out: Dict[str, Tuple[tuple, str, int]] = {}
+    for s in sources:
+        if not s.rel.endswith("obs/series.py") or s.tree is None:
+            continue
+        for node in ast.walk(s.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "ALERT_RULES":
+                    try:
+                        out[tgt.id] = (tuple(ast.literal_eval(node.value)),
+                                       s.rel, node.lineno)
+                    except ValueError:
+                        pass
+    return out
+
+
 def _journey_aliases(tree) -> set:
     """Names the journey module is bound to in one source file
     (``from eventgpt_tpu.obs import journey as obs_journey`` et al) —
@@ -404,6 +425,25 @@ class LabelEnumRule(Rule):
                     f"enum {tuple(declared)} (obs/metrics.py "
                     f"METRIC_LABELS) — keep the two literals "
                     f"identical"))
+        # Alert-rule enum cross-check (ISSUE 15 satellite): the alert
+        # metrics' ``rule`` label enums must BE obs/series.py's
+        # ALERT_RULES literal — the evaluator exports
+        # ``egpt_alert_active{rule=...}`` for every member on every
+        # transition, so a divergence raises at the first sample.
+        senums = _series_enums(ctx.sources)
+        if "ALERT_RULES" in senums:
+            rules, rel, line = senums["ALERT_RULES"]
+            for metric in ("egpt_alert_active",
+                           "egpt_alert_transitions_total"):
+                declared = enums.get(metric, {}).get("rule")
+                if declared is not None and tuple(declared) != rules:
+                    out.append(Finding(
+                        self.id, rel, line,
+                        f"obs/series.py ALERT_RULES {rules} diverged "
+                        f"from {metric}'s rule enum "
+                        f"{tuple(declared)} (obs/metrics.py "
+                        f"METRIC_LABELS) — keep the two literals "
+                        f"identical"))
         if "EVENT_KINDS" in jenums:
             kinds = jenums["EVENT_KINDS"][0]
             for s in ctx.sources:

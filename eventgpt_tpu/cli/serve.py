@@ -62,6 +62,7 @@ from typing import Any, Dict, List, Optional
 from eventgpt_tpu import faults  # stdlib-only; safe before jax loads
 from eventgpt_tpu.obs import journey as obs_journey  # stdlib-only too
 from eventgpt_tpu.obs import metrics as obs_metrics
+from eventgpt_tpu.obs import series as obs_series  # stdlib-only too
 from eventgpt_tpu.obs import trace as obs_trace
 
 
@@ -443,6 +444,18 @@ class ServingEngine:
         # egpt-check: ignore[lock] -- same read-only recorder surface as journey()
         return self.batcher.journey_index(n)
 
+    def series(self, window_s: Optional[float] = None,
+               n: Optional[int] = None) -> Dict[str, Any]:
+        """The ``GET /series`` payload (ISSUE 15): the sampled
+        time-series ring + windowed derivations. Lock-free here — the
+        store guards its own host-side state, like the recorder."""
+        return obs_series.snapshot(window_s=window_s, n=n)
+
+    def alerts(self) -> Dict[str, Any]:
+        """The ``GET /alerts`` payload (ISSUE 15): per-rule hysteresis
+        state + the bounded transition log."""
+        return obs_series.alerts()
+
     def memory_stats(self) -> Dict[str, Any]:
         """The ``GET /memory`` payload (ISSUE 9): ledger + fresh
         live-array reconciliation + static estimate + compiled
@@ -464,6 +477,10 @@ class ServingEngine:
             # in Prometheus text, summarized — histogram p50/p99 are log2-
             # bucket upper bounds, see obs/metrics.py.
             "metrics": obs_metrics.serve_summary(),
+            # Health state next to latency and bytes (ISSUE 15): active
+            # alert rules + the last few transitions; the full log and
+            # the series behind it ride GET /alerts and GET /series.
+            "alerts": obs_series.alert_stats(),
         }
 
     def shutdown(self) -> None:
@@ -790,6 +807,27 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                     return
                 self._json(200, {"requests": engine.journeys(n),
                                  "enabled": obs_journey.enabled()})
+                return
+            if route == "/series":
+                # Time-series store (ISSUE 15): the sampled ring +
+                # windowed derivations (?window_s=S bounds the
+                # derivation window, ?n=N the returned points). Fleet
+                # engines aggregate per-replica/per-worker stores.
+                try:
+                    window_s = (float(query["window_s"][0])
+                                if "window_s" in query else None)
+                    n = int(query["n"][0]) if "n" in query else None
+                except (ValueError, IndexError):
+                    self._json(400, {"error": "bad ?window_s= or ?n="})
+                    return
+                self._json(200, engine.series(window_s=window_s, n=n))
+                return
+            if route == "/alerts":
+                # Burn-rate alert state (ISSUE 15): per-rule hysteresis
+                # state + the bounded firing/clearing log — the runbook
+                # entry point (/alerts -> /series -> /requests ->
+                # /request?rid=N, OBSERVABILITY.md).
+                self._json(200, engine.alerts())
                 return
             if route == "/trace":
                 tracer = obs_trace.active()
@@ -1222,6 +1260,9 @@ def _worker_argv(args) -> list:
                                                 5.0)),
             "--slo_window", str(getattr(args, "slo_window", 256)),
             "--journey_keep", str(getattr(args, "journey_keep", 512)),
+            "--series_interval_s", str(getattr(args, "series_interval_s",
+                                               1.0)),
+            "--series_keep", str(getattr(args, "series_keep", 512)),
             ]
     if getattr(args, "spec_buckets", None):
         # Adaptive speculation (ISSUE 13): workers run their own
@@ -1273,6 +1314,7 @@ def build_engine(args, force_single: bool = False):
         obs_metrics.configure(False)
         obs_trace.disable()
         obs_journey.disable()
+        obs_series.disable()
     else:
         buf = int(getattr(args, "trace_buffer", 65536) or 0)
         if buf > 0:
@@ -1283,6 +1325,18 @@ def build_engine(args, force_single: bool = False):
         keep = int(getattr(args, "journey_keep", 512) or 0)
         if keep > 0:
             obs_journey.configure(keep)
+        # Time-series store + burn-rate alerts (ISSUE 15): samples the
+        # registry on a fixed cadence into a bounded ring and evaluates
+        # ALERT_RULES each tick (0 disarms either flag; armed cost is
+        # one registry read per interval, chain-neutral like the rest).
+        interval = float(getattr(args, "series_interval_s", 1.0) or 0.0)
+        skeep = int(getattr(args, "series_keep", 512) or 0)
+        if interval > 0 and skeep > 0:
+            cap_mb = float(getattr(args, "mem_capacity_mb", 0.0) or 0.0)
+            obs_series.configure(
+                interval_s=interval, keep=skeep,
+                mem_capacity_bytes=(int(cap_mb * 2 ** 20)
+                                    if cap_mb > 0 else None))
     if getattr(args, "profile_dir", None):
         from eventgpt_tpu.obs import profiling as obs_profiling
 
@@ -1717,6 +1771,15 @@ def main(argv=None):
                         "GET /request?rid=N, per-request debug blocks "
                         "and the egpt_serve_slo_miss_cause_total "
                         "attribution ride it; 0 disarms)")
+    p.add_argument("--series_interval_s", type=float, default=1.0,
+                   help="time-series store sampling cadence: one "
+                        "registry sample + alert-rule evaluation per "
+                        "interval (GET /series, GET /alerts; 0 disarms "
+                        "the store and the burn-rate alerts)")
+    p.add_argument("--series_keep", type=int, default=512,
+                   help="time-series ring length in samples (bounded "
+                        "retention: keep x interval seconds of history; "
+                        "0 disarms)")
     p.add_argument("--trace_buffer", type=int, default=65536,
                    help="request/step trace ring capacity in events "
                         "(GET /trace snapshots it; 0 disarms tracing)")

@@ -61,6 +61,7 @@ from eventgpt_tpu import faults
 from eventgpt_tpu.constants import EVENT_TOKEN_INDEX
 from eventgpt_tpu.obs import journey as obs_journey
 from eventgpt_tpu.obs import metrics as obs_metrics
+from eventgpt_tpu.obs import series as obs_series
 from eventgpt_tpu.obs import trace as obs_trace
 
 # Per-class base backoff for 429 hints: batch traffic has latency
@@ -499,6 +500,11 @@ class Fleet:
             # fleet's memory story (per-replica shares are in
             # per_replica[].memory_bytes above).
             "memory": _ledger_summary(),
+            # Active alert rules + last transitions (ISSUE 15): the
+            # store samples the process registry, which already carries
+            # the fleet aggregates (egpt_fleet_queue_depth feeds the
+            # queue_trend rule), so one store senses the whole fleet.
+            "alerts": obs_series.alert_stats(),
         }
 
     def fleet_stats(self) -> Dict[str, Any]:
@@ -533,6 +539,27 @@ class Fleet:
             for rep in self.replicas
         ]
         return out
+
+    def series(self, window_s: Optional[float] = None,
+               n: Optional[int] = None) -> Dict[str, Any]:
+        """The fleet ``GET /series`` payload (ISSUE 15). One process,
+        one registry, one store: replicas are threads, the sampler
+        already sees the fleet-wide gauges (the router overwrites
+        egpt_fleet_queue_depth each route, each replica's scheduler the
+        serve gauges — the store samples max of the two). Per-replica
+        instantaneous context rides alongside the shared ring."""
+        out = obs_series.snapshot(window_s=window_s, n=n)
+        out["per_replica"] = [
+            {"replica": rep.idx, "state": rep.state,
+             "queued": rep.engine.snapshot().get("queued", 0)}
+            for rep in self.replicas
+        ]
+        return out
+
+    def alerts(self) -> Dict[str, Any]:
+        """The fleet ``GET /alerts`` payload (ISSUE 15): the shared
+        process store's rule state — fleet-wide by construction."""
+        return obs_series.alerts()
 
     def slo_stats(self) -> Dict[str, Any]:
         """Aggregate per-class attainment across replicas (the bench's

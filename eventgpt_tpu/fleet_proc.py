@@ -92,6 +92,7 @@ from eventgpt_tpu import faults, rpc
 from eventgpt_tpu.fleet import affinity_key
 from eventgpt_tpu.obs import journey as obs_journey
 from eventgpt_tpu.obs import metrics as obs_metrics
+from eventgpt_tpu.obs import series as obs_series
 from eventgpt_tpu.obs import trace as obs_trace
 
 def _map_remote(e: rpc.RpcRemoteError) -> Exception:
@@ -193,11 +194,22 @@ class WorkerHandler:
             pc = dict(eng.batcher.prefix_cache_stats())
             pc.pop("entries", None)  # per-entry dumps don't aggregate
             s["prefix_cache"] = pc
+            # Active alert rules ride the probe snapshot (ISSUE 15), so
+            # the coordinator's /stats can show fleet-wide health state
+            # without an extra RPC fan-out per poll.
+            s["alerts_active"] = eng.alerts().get("active", [])
             return s
         if op == "stats":
             return eng.stats()
         if op == "memory":
             return eng.memory_stats()
+        if op == "series":
+            # Time-series pull (ISSUE 15): the worker's own store, ages
+            # already duration-aligned to the worker's clock — absolute
+            # perf_counter values never cross the process boundary.
+            return eng.series(window_s=p.get("window_s"), n=p.get("n"))
+        if op == "alerts":
+            return eng.alerts()
         if op == "journey":
             return eng.journey(int(p["rid"]))
         if op == "set_prefix":
@@ -298,6 +310,7 @@ class _StubEngine:
                    deadline_s=None, slo=None) -> int:
         if not self.alive:
             raise RuntimeError("stub engine is down (killed)")
+        obs_series.note_submit()
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
@@ -366,6 +379,15 @@ class _StubEngine:
     def memory_stats(self) -> dict:
         return {"stub": True}
 
+    def series(self, window_s=None, n=None) -> dict:
+        # The stub worker arms a REAL store (series.py is jax-free), so
+        # the procfleet aggregation tests exercise the genuine RPC +
+        # merge path at stub speed.
+        return obs_series.snapshot(window_s=window_s, n=n)
+
+    def alerts(self) -> dict:
+        return obs_series.alerts()
+
     def journey(self, rid):
         return None
 
@@ -386,6 +408,9 @@ def _stub_main(argv=None) -> int:
     p.add_argument("--heartbeat_dir", default=None)
     p.add_argument("--token_delay_s", type=float, default=0.005)
     args = p.parse_args(argv)
+    # A real (tiny) time-series store per stub worker: the aggregation
+    # tests assert over genuine sampled rings, not canned dicts.
+    obs_series.configure(interval_s=0.02, keep=256)
     engine = _StubEngine(token_delay_s=args.token_delay_s)
     if args.heartbeat_dir:
         from eventgpt_tpu.train.resilience import Heartbeat
@@ -823,6 +848,9 @@ class ProcFleet:
         unreachable worker is marked suspect and the NEXT candidate is
         tried instead, so transport trouble costs locality, not
         availability), track for supervision."""
+        # Coordinator-side arrival sensing (ISSUE 15): workers only see
+        # their routed share, so the fleet-wide EWMA lives here.
+        obs_series.note_submit()
         key = affinity_key(input_ids, pixels)
         with self._lock:
             last_err: Optional[Exception] = None
@@ -998,6 +1026,15 @@ class ProcFleet:
             "memory": {"per_worker": [
                 {"worker": p["worker"], "memory_bytes": p["memory_bytes"]}
                 for p in per]},
+            # Coordinator store state + each worker's active rules from
+            # the cached probe snapshots (ISSUE 15) — no RPC fan-out on
+            # the stats poll; GET /alerts pulls the full worker logs.
+            "alerts": {
+                **obs_series.alert_stats(),
+                "workers_active": sorted({
+                    r for slot in self.slots
+                    for r in slot.snapshot.get("alerts_active", [])}),
+            },
         }
 
     def fleet_stats(self) -> Dict[str, Any]:
@@ -1036,6 +1073,71 @@ class ProcFleet:
                 out.append({"worker": slot.idx, "state": slot.state,
                             "error": repr(e)})
         return {"proc_fleet": True, "workers": out}
+
+    def series(self, window_s: Optional[float] = None,
+               n: Optional[int] = None) -> Dict[str, Any]:
+        """``GET /series``, process-fleet form (ISSUE 15): each
+        worker's OWN sampled ring + derivations, fetched over RPC, plus
+        the coordinator's store. Every export is duration-aligned
+        (ages relative to each store's own now) — worker perf_counter
+        clocks are not comparable across processes, ages are. A worker
+        that does not answer inside the deadline reports an error entry
+        instead of stalling the route (the /memory contract)."""
+        workers = []
+        for slot in self.slots:
+            if slot.addr is None:
+                workers.append({"worker": slot.idx, "state": slot.state})
+                continue
+            try:
+                workers.append({"worker": slot.idx, "state": slot.state,
+                                **self._rpc(slot, "series",
+                                            {"window_s": window_s, "n": n},
+                                            deadline_s=10.0)})
+            except rpc.RpcError as e:
+                workers.append({"worker": slot.idx, "state": slot.state,
+                                "error": repr(e)})
+        # Fleet-wide aggregate over the answering workers: rates sum,
+        # depths sum, attainment floors take the worst replica.
+        agg: Dict[str, float] = {}
+        for w in workers:
+            d = w.get("derived") or {}
+            for key in ("request_rate_per_s", "token_rate_per_s",
+                        "submit_rate_per_s", "queue_depth_last"):
+                if key in d:
+                    agg[key] = round(agg.get(key, 0.0) + d[key], 6)
+            for key in ("goodput_ratio_min", "attainment_windowed"):
+                if key in d:
+                    agg[key] = min(agg.get(key, 1.0), d[key])
+        return {
+            "proc_fleet": True,
+            "coordinator": obs_series.snapshot(window_s=window_s, n=n),
+            "workers": workers,
+            "aggregate": agg,
+        }
+
+    def alerts(self) -> Dict[str, Any]:
+        """``GET /alerts``, process-fleet form: the coordinator's rule
+        state + each worker's, pulled over RPC (error entries for
+        non-answering workers, like /series)."""
+        workers = []
+        for slot in self.slots:
+            if slot.addr is None:
+                workers.append({"worker": slot.idx, "state": slot.state})
+                continue
+            try:
+                workers.append({"worker": slot.idx, "state": slot.state,
+                                **self._rpc(slot, "alerts",
+                                            deadline_s=10.0)})
+            except rpc.RpcError as e:
+                workers.append({"worker": slot.idx, "state": slot.state,
+                                "error": repr(e)})
+        return {
+            "proc_fleet": True,
+            "coordinator": obs_series.alerts(),
+            "workers": workers,
+            "active": sorted({r for w in workers
+                              for r in w.get("active", [])}),
+        }
 
     def reset_stats(self, clear_prefix_cache: bool = False) -> None:
         """Zero the phase-scoped counters here and in every worker

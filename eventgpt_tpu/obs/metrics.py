@@ -129,6 +129,19 @@ METRIC_LABELS = {
         "cause": ("queue", "defer", "admission", "decode", "host_gap",
                   "failover_redo", "nan_quarantine", "shed", "other"),
     },
+    "egpt_alert_active": {
+        # The alert evaluator's CLOSED rule enum (obs/series.py
+        # ALERT_RULES — keep the two literals identical; the egpt-check
+        # rule-5 cross-check asserts equality, this enum enforces at
+        # observe time).
+        "rule": ("slo_burn", "queue_trend", "cause_shift", "breaker_flap",
+                 "mem_shrink"),
+    },
+    "egpt_alert_transitions_total": {
+        # Same enum as egpt_alert_active (ALERT_RULES, obs/series.py).
+        "rule": ("slo_burn", "queue_trend", "cause_shift", "breaker_flap",
+                 "mem_shrink"),
+    },
 }
 
 
@@ -240,6 +253,14 @@ class Counter(_Metric):
         with self._lock:
             return sum(self._values.values())
 
+    def labeled(self) -> Dict[tuple, float]:
+        """Snapshot of every label set's value, keyed by the sorted
+        ``((key, value), ...)`` tuple — the time-series sampler's
+        cumulative read (obs/series.py derives windowed per-label
+        rates from deltas of this)."""
+        with self._lock:
+            return dict(self._values)
+
     def _reset(self) -> None:
         with self._lock:
             self._values.clear()
@@ -313,6 +334,18 @@ class Histogram(_Metric):
     def count(self, **labels) -> float:
         with self._lock:
             return self._totals.get(self._key(labels), 0.0)
+
+    def agg_counts(self) -> List[float]:
+        """Per-bucket counts aggregated over every label set (overflow
+        last, same order as ``bounds`` + implicit +Inf) — the
+        time-series sampler's cumulative read: windowed quantiles come
+        from deltas of consecutive snapshots (obs/series.py)."""
+        with self._lock:
+            agg = [0.0] * (len(self.bounds) + 1)
+            for c in self._counts.values():
+                for i, v in enumerate(c):
+                    agg[i] += v
+            return agg
 
     def quantile(self, q: float) -> float:
         """Upper-bound estimate of the q-quantile, aggregated over every
@@ -745,6 +778,18 @@ MEM_COMPILED_ARGUMENT = REGISTRY.gauge(
 MEM_COMPILED_OUTPUT = REGISTRY.gauge(
     "egpt_mem_compiled_output_bytes",
     "XLA output size of the probed segment executable")
+
+# -- time-series store + burn-rate alerting (ISSUE 15,
+#    eventgpt_tpu/obs/series.py) --
+ALERT_ACTIVE = REGISTRY.gauge(
+    "egpt_alert_active",
+    "1 while the named alert rule is firing, 0 once it cleared "
+    "(hysteresis + multi-window burn rates; the rule enum is "
+    "ALERT_RULES in obs/series.py)")
+ALERT_TRANSITIONS = REGISTRY.counter(
+    "egpt_alert_transitions_total",
+    "Alert rule state transitions (firing and cleared both count; an "
+    "odd count means the rule is currently active)")
 
 # -- fault injection (eventgpt_tpu/faults.py) --
 FAULT_TRIPS = REGISTRY.counter(

@@ -107,6 +107,7 @@ from eventgpt_tpu.obs import journey as obs_journey
 from eventgpt_tpu.obs import memory as obs_memory
 from eventgpt_tpu.obs import metrics as obs_metrics
 from eventgpt_tpu.obs import profiling as obs_profiling
+from eventgpt_tpu.obs import series as obs_series
 from eventgpt_tpu.obs import trace as obs_trace
 from eventgpt_tpu.constants import SEQ_BUCKET
 from eventgpt_tpu.models import eventchat, llama as llama_mod
@@ -2656,6 +2657,7 @@ class ContinuousBatcher:
             self._journey_owner, rid, t=req.t_submit,
             prompt_len=prompt_len, budget=max_new_tokens,
             **({"slo_class": slo.name} if slo is not None else {}))
+        obs_series.note_submit()
         return rid
 
     def cancel(self, rid: int) -> bool:

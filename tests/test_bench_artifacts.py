@@ -107,6 +107,65 @@ def test_workload_artifacts_schema():
             f"the <2% contract"
 
 
+def test_workload_artifacts_carry_series_and_alerts():
+    """ISSUE 15 acceptance shape: every serve leg records the sampled
+    time-series timeline + per-point alert firings. The saturation
+    story is IN the artifact: the top offered-load point fired
+    queue_trend (sustained depth + arrival pressure) while the x1
+    point fired nothing — regenerating a record where the healthy leg
+    pages, or the saturated one stays silent, breaks tier-1 here."""
+    from eventgpt_tpu.obs.series import ALERT_RULES
+
+    paths = sorted(glob.glob(os.path.join(ROOT, "WORKLOAD_r0*.json")))
+    assert paths, "no WORKLOAD_r0*.json checked in"
+    for p in paths:
+        rec = _load(p)
+        for leg in rec["sweep"]:
+            ser = leg["series"]
+            for k in ("interval_s", "samples", "request_rate_per_s",
+                      "token_rate_per_s", "submit_rate_per_s",
+                      "arrival_rate_ewma", "queue_depth_last",
+                      "queue_depth_max", "goodput_ratio_min", "points"):
+                assert k in ser, (p, leg["rate_mult"], k)
+            assert ser["samples"] >= 2, (p, leg["rate_mult"])
+            for pt in ser["points"]:
+                # Duration-aligned: ages only, never an absolute
+                # perf_counter float (meaningless across processes).
+                assert "age_s" in pt and "t" not in pt, (p, pt)
+                assert "queue_depth" in pt and "goodput_ratio" in pt
+            al = leg["alerts"]
+            assert set(al["fired"]) == set(ALERT_RULES), (p, al)
+            assert al["fired_total"] == sum(al["fired"].values()), (p, al)
+            assert isinstance(al["active_end"], list), p
+            assert isinstance(al["log"], list), p
+        legs = sorted(rec["sweep"], key=lambda l: l["rate_mult"])
+        lo, hi = legs[0], legs[-1]
+        assert lo["alerts"]["fired_total"] == 0, \
+            f"{p}: alerts paged at x{lo['rate_mult']} (healthy load)"
+        assert hi["alerts"]["fired"]["queue_trend"] >= 1, \
+            f"{p}: x{hi['rate_mult']} saturation did not fire queue_trend"
+
+
+def test_compare_bench_gates_series_alert_columns():
+    """ISSUE 15 satellite: the tier-1 gate --require's the series and
+    alert columns — self-comparable on the checked-in artifact, loud
+    the day a record stops carrying them. The list-shaped leaves
+    (points / log / active_end) drop from flattening by design: the
+    gate diffs the derived numbers, not raw timelines."""
+    mod = _compare_mod()
+    rec = _load(os.path.join(ROOT, "WORKLOAD_r01.json"))
+    require = ("arrival_rate_ewma", "fired_total", "queue_depth_last")
+    regs, _ = mod.compare(rec, rec, require=require)
+    assert regs == [], f"series/alert columns must be self-comparable: " \
+                       f"{regs}"
+    legacy = json.loads(json.dumps(rec))
+    for leg in legacy["sweep"]:
+        leg.pop("series")
+        leg.pop("alerts")
+    regs, _ = mod.compare(legacy, rec, require=require)
+    assert any("not comparable" in r for r in regs), regs
+
+
 def test_fleet_workload_artifact_schema():
     """ISSUE 7 acceptance shape: >= 2 replicas, >= 2 offered-load
     points, and per-replica goodput / hit-ratio / failover counts in

@@ -376,6 +376,40 @@ def test_journey_kind_cross_check_picks_up_procfleet_members():
     assert lits == [EVENT_KINDS]
 
 
+def test_label_rule_alert_rules_enum_cross_check(tmp_path):
+    """ISSUE 15 satellite: rule 5 cross-checks the alert evaluator's
+    CLOSED rule enum — obs/series.py ALERT_RULES must be byte-for-byte
+    identical to the ``rule`` label enums declared for BOTH alert
+    metrics in obs/metrics.py. The matching pair stays clean; a
+    divergence (rule added on one side only) is a finding."""
+    from eventgpt_tpu.analysis.telemetry_rules import LabelEnumRule
+
+    def tree(metric_rules):
+        pkg = tmp_path / "eventgpt_tpu"
+        pkg.mkdir(exist_ok=True)
+        obs = pkg / "obs"
+        obs.mkdir(exist_ok=True)
+        (obs / "series.py").write_text(
+            'ALERT_RULES = ("slo_burn", "queue_trend")\n')
+        (obs / "metrics.py").write_text(
+            "METRIC_LABELS = {\n"
+            f'    "egpt_alert_active": {{"rule": {metric_rules!r}}},\n'
+            '    "egpt_alert_transitions_total": {\n'
+            f'        "rule": {metric_rules!r}}},\n'
+            "}\n")
+        return tmp_path
+
+    msgs = [f.message for f in _run(
+        tree(("slo_burn", "queue_trend")), [LabelEnumRule()])
+        if not f.waived]
+    assert not any("ALERT_RULES" in m for m in msgs), msgs
+    msgs = [f.message for f in _run(
+        tree(("slo_burn", "mem_shrink")), [LabelEnumRule()])
+        if not f.waived]
+    assert sum("ALERT_RULES" in m and "diverged" in m
+               for m in msgs) == 2, msgs
+
+
 def test_malformed_waivers_are_findings(tmp_path):
     pkg = _pkg(tmp_path)
     (pkg / "x.py").write_text(

@@ -204,3 +204,51 @@ def test_stream_delivers_at_finish_with_sentinel():
         assert q.get(timeout=10) is None
     finally:
         fleet.shutdown()
+
+
+def test_series_and_alerts_aggregate_over_stub_workers():
+    """GET /series, process-fleet form (ISSUE 15): each stub worker
+    arms a REAL store (series.py is jax-free), the coordinator pulls
+    the rings over RPC, duration-aligns them, and folds a fleet-wide
+    aggregate. /alerts unions the active rules; the /stats alerts
+    block carries the probe-cached worker state without an RPC
+    fan-out."""
+    from eventgpt_tpu.obs import series as obs_series
+
+    obs_series.configure(interval_s=0.02, keep=256)
+    fleet = _fleet()
+    try:
+        for i in range(4):
+            ids = [1, 2, EVENT, 5 + i]
+            fr = fleet.submit_ids(ids, _pv(i), 4)
+            assert fleet.result(fr, timeout=60) == _stub_chain(ids, 4)
+        time.sleep(0.15)  # a few sampler ticks on both sides of the RPC
+        s = fleet.series()
+        assert s["proc_fleet"] is True
+        assert s["coordinator"]["enabled"] is True
+        assert len(s["workers"]) == 2
+        for w in s["workers"]:
+            assert w["enabled"] is True
+            assert w["samples"] >= 2
+            # Duration-aligned: worker clocks never cross the process
+            # boundary, only ages do.
+            for p in w["points"]:
+                assert "age_s" in p and "t" not in p
+        # Every healthy worker contributed to the rollup.
+        assert "queue_depth_last" in s["aggregate"]
+        assert "request_rate_per_s" in s["aggregate"]
+
+        a = fleet.alerts()
+        assert a["proc_fleet"] is True
+        assert a["coordinator"]["enabled"] is True
+        assert len(a["workers"]) == 2
+        for w in a["workers"]:
+            assert set(w["rules"]) == set(obs_series.ALERT_RULES)
+        assert isinstance(a["active"], list)
+
+        st = fleet.stats()
+        assert st["alerts"]["enabled"] is True
+        assert isinstance(st["alerts"]["workers_active"], list)
+    finally:
+        fleet.shutdown()
+        obs_series.disable()

@@ -18,6 +18,7 @@ import pytest
 
 from eventgpt_tpu.obs import metrics as obs_metrics
 from eventgpt_tpu.obs import profiling as obs_profiling
+from eventgpt_tpu.obs import series as obs_series
 from eventgpt_tpu.obs import trace as obs_trace
 
 
@@ -279,6 +280,7 @@ def obs_server():
     engine.shutdown()
     httpd.server_close()
     obs_trace.disable()
+    obs_series.disable()
 
 
 def _get(url, timeout=30):
@@ -318,6 +320,41 @@ def test_stats_merges_registry_summary(obs_server):
     s = json.loads(body)
     assert "egpt_serve_ttft_seconds" in s["metrics"]
     assert "count" in s["metrics"]["egpt_serve_ttft_seconds"]
+
+
+def test_series_and_alerts_routes(obs_server):
+    """ISSUE 15: GET /series is the sampled ring (duration-aligned
+    points + windowed derivations), GET /alerts the per-rule hysteresis
+    state, and /stats carries the cheap "alerts" block (the "slo" /
+    "memory" merge pattern) — all armed by the default
+    --series_interval_s on a plain single-engine server."""
+    from eventgpt_tpu.obs.series import ALERT_RULES
+
+    url, _ = obs_server
+    status, _, body = _get(url + "/series?window_s=30&n=16")
+    assert status == 200
+    obj = json.loads(body)
+    assert obj["enabled"] is True
+    assert "derived" in obj and isinstance(obj["points"], list)
+    for p in obj["points"]:
+        assert "age_s" in p and "t" not in p
+
+    status, _, body = _get(url + "/alerts")
+    assert status == 200
+    al = json.loads(body)
+    assert al["enabled"] is True
+    assert set(al["rules"]) == set(ALERT_RULES)
+    assert isinstance(al["active"], list) and isinstance(al["log"], list)
+
+    status, _, body = _get(url + "/stats")
+    assert status == 200
+    st = json.loads(body)
+    assert st["alerts"]["enabled"] is True
+    assert isinstance(st["alerts"]["active"], list)
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(url + "/series?window_s=bogus")
+    assert e.value.code == 400
 
 
 def test_post_profile_smoke(obs_server):
