@@ -1448,6 +1448,225 @@ def run_workload_spec(args):
     return record
 
 
+def run_workload_oom(args):
+    """Pool-oversubscription preemption A/B (ISSUE 16 — THE judgment
+    the tentpole is shipped on). One seeded trace replayed at every
+    ``--oom_oversub`` undersizing point — the paged block pool shrunk
+    to 1/x of the trace's dense-equivalent capacity — by two arms:
+
+      * **defer** — the pre-16 policy: an interactive admission that
+        free blocks cannot cover waits behind the batch rows holding
+        them (the OOM cliff, paid in interactive TTFT);
+      * **preempt** — block-tier preemption armed: the head evicts the
+        lowest-value batch row, which spills its KV run to host RAM or
+        drops and re-prefills (whichever the measured bytes-vs-FLOPs
+        price says), and re-enters at the back of the queue.
+
+    Both arms must finish every request with its chain byte-identical
+    to an UNPREEMPTED ample-pool reference replay (``chains_identical``
+    — preemption is a scheduling decision, never a numerics one), with
+    zero ``BlockPoolError``s; the preempt arm's interactive attainment
+    and goodput are the graceful-degradation curve PERFORMANCE.md
+    plots. Writes the WORKLOAD_OOM_r0N.json artifact via
+    --workload_out."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from eventgpt_tpu import workload as wl
+    from eventgpt_tpu.constants import SEQ_BUCKET
+    from eventgpt_tpu.obs import metrics as obs_metrics
+    from eventgpt_tpu.serve import ContinuousBatcher
+    from eventgpt_tpu.serve_blocks import BlockPoolError
+
+    obs_metrics.configure(bool(args.serve_telemetry))
+    preset, cfg, platform = _resolve_preset(args)
+    dtype = jnp.bfloat16
+    quant = args.quant if preset in ("7b", "13b") else "bf16"
+    params = _build_params(cfg, dtype, quant)
+
+    spec = wl.WorkloadSpec(
+        seed=args.workload_seed, n_requests=args.workload_requests,
+        rate_rps=args.workload_rate, arrival=args.workload_arrival,
+        sessions=args.workload_sessions,
+        output_min=args.workload_output_min,
+        output_max=args.workload_output_max,
+        interactive_ttft_s=args.slo_ttft_s,
+        interactive_itl_s=args.slo_itl_s,
+        batch_latency_s=args.slo_latency_s,
+    )
+    trace = wl.generate_trace(spec)
+    class_of = {r.idx: r.slo_class for r in trace}
+
+    shape = (cfg.num_event_frames, 3, cfg.vision.image_size,
+             cfg.vision.image_size)
+    pix_cache = {}
+
+    def pixels_for(r):
+        if r.pixels_seed not in pix_cache:
+            pix_cache[r.pixels_seed] = wl.stream_pixels(shape, r.pixels_seed)
+        return pix_cache[r.pixels_seed]
+
+    def slo_for(r):
+        return spec.slo_for(r.slo_class)
+
+    need = max(wl.cache_positions(r, cfg.num_event_tokens)
+               + r.max_new_tokens for r in trace)
+    max_len = ((need + 1 + 127) // 128) * 128
+    plens = sorted({wl.cache_positions(r, cfg.num_event_tokens)
+                    for r in trace})
+    # The dense-equivalent pool (what kv_pool_blocks=0 sizes) and the
+    # floor below which submit() itself refuses the largest request —
+    # undersizing clamps there, so every point is oversubscribed but
+    # admissible.
+    full_blocks = args.serve_batch * (max_len // SEQ_BUCKET) + 1
+    biggest = max(
+        (min(max(((wl.cache_positions(r, cfg.num_event_tokens)
+                   + 2 * SEQ_BUCKET - 1) // (2 * SEQ_BUCKET))
+                 * (2 * SEQ_BUCKET),
+                 wl.cache_positions(r, cfg.num_event_tokens)
+                 + r.max_new_tokens + 1), max_len)
+         + SEQ_BUCKET - 1) // SEQ_BUCKET
+        for r in trace)
+
+    def make_srv(pool_blocks, preempt):
+        return ContinuousBatcher(
+            params, cfg, max_batch=args.serve_batch, max_len=max_len,
+            chunk=args.serve_chunk, eos_token_id=None,
+            kv_quant=args.kv == "int8",
+            pipeline=bool(args.serve_pipeline),
+            prefix_cache=bool(args.serve_prefix_cache),
+            prefix_insert=bool(args.serve_cache_insert),
+            prefill_budget=int(args.serve_prefill_budget),
+            kv_layout="paged", kv_pool_blocks=pool_blocks,
+            preempt=preempt,
+            spill_capacity_mb=int(args.oom_spill_mb) if preempt else 0,
+        )
+
+    def run_leg(pool_blocks, preempt, oversub, paced=True, warm=False):
+        srv = make_srv(pool_blocks, preempt)
+        if preempt and platform == "cpu":
+            # The 5e12 FLOP/s recompute price assumes an accelerator;
+            # a CPU prefill sustains orders of magnitude less, so spill
+            # would never win on the smoke preset. Price it at a
+            # CPU-scale sustained rate instead — the policy then splits
+            # honestly between spill and drop per victim size.
+            srv._recompute_flops_per_s = 1e9
+        if warm and args.warmup:
+            srv.warmup(prompt_lens=plens)
+            wl.replay(srv, trace, pixels_for=pixels_for, paced=False)
+            srv.reset_serving_stats()
+            obs_metrics.REGISTRY.reset()
+        res = wl.replay(srv, trace, pixels_for=pixels_for,
+                        rate_mult=args.oom_rate_mult if paced else 1.0,
+                        paced=paced, slo_for=slo_for)
+        st = srv.slo_stats()
+        met = sum(c["met"] for c in st["classes"].values())
+        fin = sum(c["finished"] for c in st["classes"].values())
+        toks = sum(len(v) for v in res["finished"].values())
+        # replay()'s finished map is keyed by TRACE idx already (NOT
+        # rid — a warmed server's measured replay hands out rids past
+        # the warm leg's, so indexing by rid silently drops chains).
+        chains = {int(i): v for i, v in res["finished"].items()}
+        pool = srv._pool.stats()
+        leg = {
+            # compare_bench pairs sweep points by rate_mult; the swept
+            # axis HERE is pool undersizing, so the factor takes that
+            # slot (the offered mult is constant — echoed below).
+            "rate_mult": oversub,
+            "pool_blocks": pool_blocks,
+            "offered_mult": args.oom_rate_mult,
+            "duration_s": round(res["duration_s"], 3),
+            "goodput_rps": round(met / res["duration_s"], 3),
+            "slo_met_ratio": round(met / max(fin, 1), 4),
+            "tok_s": round(toks / res["duration_s"], 2),
+            "classes": {
+                cname: {"requests": cagg["finished"], "met": cagg["met"],
+                        "attainment": round(cagg["attainment"], 4)}
+                for cname, cagg in sorted(st["classes"].items())
+            },
+            "preemptions_total": srv.preemptions,
+            "kv_block_deferrals": srv.block_deferrals,
+            "spills": pool["spills"],
+            "restores": pool["restores"],
+            "spilled_runs_leaked": pool["spilled_runs"],
+            **({"spill_store": {
+                k: srv._spill_store.stats()[k]
+                for k in ("used_bytes", "puts", "takes", "drops",
+                          "rejects")}}
+               if srv._spill_store is not None else {}),
+        }
+        return leg, chains
+
+    oversubs = [float(x) for x in args.oom_oversub.split(",") if x]
+    # Unpreempted ample-pool reference: THE chains every arm must
+    # reproduce (and the warm leg that pays the XLA compiles once).
+    _, ref_chains = run_leg(full_blocks, False, 1.0, paced=False,
+                            warm=True)
+
+    legs = {"defer": {"sweep": []}, "preempt": {"sweep": []}}
+    chains_identical = True
+    pool_errors = 0
+    for x in oversubs:
+        pool_blocks = max(int(full_blocks / x), biggest + 1, 3)
+        for arm, preempt in (("defer", False), ("preempt", True)):
+            try:
+                leg, chains = run_leg(pool_blocks, preempt, x)
+            except BlockPoolError as e:  # acceptance: NEVER fires
+                pool_errors += 1
+                sys.stderr.write(f"workload_oom {arm} x{x}: "
+                                 f"BlockPoolError {e}\n")
+                continue
+            same = chains == ref_chains
+            chains_identical &= same
+            leg["chains_identical"] = int(same)
+            legs[arm]["sweep"].append(leg)
+            sys.stderr.write(
+                f"workload_oom {arm} x{x} ({pool_blocks} blocks): "
+                f"goodput {leg['goodput_rps']} met "
+                f"{leg['slo_met_ratio']} preempts "
+                f"{leg['preemptions_total']} spills {leg['spills']} "
+                f"(chains {'==' if same else '!='})\n")
+
+    # Headline: worst-point preempt-over-defer goodput ratio — > 1.0
+    # means preemption beat deferral at EVERY oversubscription point.
+    ratios = [p["goodput_rps"] / max(d["goodput_rps"], 1e-9)
+              for d, p in zip(legs["defer"]["sweep"],
+                              legs["preempt"]["sweep"])]
+    record = {
+        "metric": f"workload_oom_ab_{preset}",
+        "value": round(min(ratios), 3) if ratios else 0.0,
+        "unit": "x (preempt/defer goodput, worst oversubscription "
+                "point)",
+        "requests": len(trace),
+        "seed": spec.seed,
+        "arrival": spec.arrival,
+        "sessions": spec.sessions,
+        "output_min": spec.output_min,
+        "output_max": spec.output_max,
+        "rate_rps": spec.rate_rps,
+        "offered_mult": args.oom_rate_mult,
+        "max_batch": args.serve_batch,
+        "chunk": args.serve_chunk,
+        "kv_layout": "paged",
+        "full_pool_blocks": full_blocks,
+        "oversub": oversubs,
+        "spill_capacity_mb": int(args.oom_spill_mb),
+        "block_pool_errors": pool_errors,
+        "chains_identical": int(chains_identical),
+        "legs": legs,
+        "warmup": bool(args.warmup),
+        "quant": quant,
+        "platform": platform,
+    }
+    print(json.dumps(record))
+    if args.workload_out:
+        with open(args.workload_out, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    return record
+
+
 def _run_workload_fleet(args, preset, cfg, platform, params, spec, trace):
     """``--mode workload --fleet N`` (ISSUE 7): replay the same seeded
     trace through the replica supervisor + prefix-affinity router
@@ -2634,7 +2853,20 @@ def main() -> None:
     p.add_argument("--mode", default="all",
                    choices=["all", "decode", "train", "train_sweep",
                             "warm_probe", "spec", "serve", "stream",
-                            "workload", "workload_spec"])
+                            "workload", "workload_spec", "workload_oom"])
+    # -- pool-oversubscription preemption A/B (ISSUE 16) --
+    p.add_argument("--oom_oversub", default="2,3,4",
+                   help="mode=workload_oom: pool-undersizing factors — "
+                        "each point shrinks the paged block pool to "
+                        "1/x of the trace's dense-equivalent capacity "
+                        "and replays defer-only vs preempt+spill arms")
+    p.add_argument("--oom_spill_mb", type=int, default=256,
+                   help="mode=workload_oom: host-RAM spill budget for "
+                        "the preemption arm")
+    p.add_argument("--oom_rate_mult", type=float, default=4.0,
+                   help="mode=workload_oom: offered-load multiplier for "
+                        "every oversubscription point (the pool, not "
+                        "the arrival rate, is the swept axis)")
     # -- trace-driven workload replay (ISSUE 6) --
     p.add_argument("--workload_requests", type=int, default=32,
                    help="mode=workload: requests in the generated trace")
@@ -2823,6 +3055,8 @@ def main() -> None:
         run_workload(args)
     elif args.mode == "workload_spec":
         run_workload_spec(args)
+    elif args.mode == "workload_oom":
+        run_workload_oom(args)
     elif args.mode == "stream":
         run_stream(args)
     else:

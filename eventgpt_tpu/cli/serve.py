@@ -1150,13 +1150,28 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
                     obj["debug"] = engine.journey(rid)
                 # Forced finishes map to structured HTTP errors (the
                 # partial answer rides along): deadline -> 504,
-                # cancel -> 499 (client asked), NaN quarantine -> 500.
+                # cancel -> 499 (client asked), NaN quarantine -> 500,
+                # resource exhaustion (block pool AND spill budget both
+                # spent — ISSUE 16) -> 503 with the same derived
+                # Retry-After the breaker/shed paths carry.
                 code = {"ok": 200, "deadline_exceeded": 504,
                         "cancelled": 499,
+                        "resource_exhausted": 503,
                         "nan_quarantined": 500}.get(status, 500)
                 if code != 200:
                     obj["error"] = status
-                self._json(code, obj)
+                if code == 503:
+                    cls_name = slo.name if slo is not None else "batch"
+                    ra = getattr(engine, "breaker_retry_after_s",
+                                 lambda: None)()
+                    if ra is None:
+                        ra = retry_after_s(cls_name,
+                                           engine.goodput_ratio())
+                    obj["retry_after_s"] = round(ra, 3)
+                    self._json(code, obj, headers={
+                        "Retry-After": str(max(1, math.ceil(ra)))})
+                else:
+                    self._json(code, obj)
             except Exception as e:
                 self._json(500, {"error": str(e)})
 
@@ -1264,6 +1279,18 @@ def _worker_argv(args) -> list:
                                                1.0)),
             "--series_keep", str(getattr(args, "series_keep", 512)),
             ]
+    if getattr(args, "kv_layout", "dense") != "dense":
+        # Paged pool + preemption tier (ISSUES 15/16): workers own
+        # their pools, so the layout and the degradation policy must
+        # cross the process boundary too (kv_layout previously stayed
+        # coordinator-side, silently running workers dense).
+        argv += ["--kv_layout", str(args.kv_layout),
+                 "--kv_pool_blocks", str(getattr(args, "kv_pool_blocks",
+                                                 0)),
+                 "--spill_capacity_mb",
+                 str(getattr(args, "spill_capacity_mb", 0))]
+        if getattr(args, "preempt", False):
+            argv += ["--preempt"]
     if getattr(args, "spec_buckets", None):
         # Adaptive speculation (ISSUE 13): workers run their own
         # controllers — the policy flags cross the process boundary
@@ -1441,6 +1468,12 @@ def build_engine(args, force_single: bool = False):
             # + used-token admission; "dense" is the A/B escape hatch.
             kv_layout=getattr(args, "kv_layout", "dense"),
             kv_pool_blocks=int(getattr(args, "kv_pool_blocks", 0)),
+            # Block-tier preemption + host-RAM KV spill (ISSUE 16):
+            # under block exhaustion an interactive admission preempts
+            # the lowest-value batch row (spill-or-recompute priced per
+            # victim) instead of deferring behind it.
+            preempt=bool(getattr(args, "preempt", False)),
+            spill_capacity_mb=int(getattr(args, "spill_capacity_mb", 0)),
             # Adaptive speculation (ISSUE 13): empty = fixed-K serving.
             spec_buckets=getattr(args, "spec_buckets", None) or None,
             spec_ema_alpha=float(getattr(args, "spec_ema_alpha", 0.3)),
@@ -1588,6 +1621,23 @@ def main(argv=None):
                         "max_batch * max_len/SEQ_BUCKET + 1). Size it by "
                         "expected USED tokens, not worst case — "
                         "GET /memory's kv_blocks shows live pressure")
+    p.add_argument("--preempt", action="store_true",
+                   help="block-tier preemption (ISSUE 16, paged layout "
+                        "only): when free blocks cannot cover an "
+                        "interactive admission, preempt the lowest-value "
+                        "batch row (worst deadline headroom first) "
+                        "instead of deferring the head behind it. Each "
+                        "victim either spills its KV run to host RAM "
+                        "(--spill_capacity_mb) or drops and re-prefills "
+                        "— whichever the measured bytes-vs-FLOPs price "
+                        "says is cheaper. Chains stay byte-identical on "
+                        "both paths")
+    p.add_argument("--spill_capacity_mb", type=int, default=0,
+                   help="host-RAM budget for preempted KV runs (0 = no "
+                        "spill store: every preemption drops and "
+                        "re-prefills). Spilled bytes show on GET /memory "
+                        "under the 'spill' component and "
+                        "egpt_serve_spill_store_bytes")
     p.add_argument("--speculative", type=int, default=0)
     p.add_argument("--spec_buckets", default="",
                    help="adaptive speculation (ISSUE 13): comma-separated "

@@ -45,6 +45,12 @@ untouched; ``serve.spec_adapt`` fires at the adaptive-speculation
 boundary decision — the controller degrades THAT boundary to the fixed
 default window at full depth, chains untouched),
 ``serve.prefix_copy`` (prefix-cache entry copy at admission),
+``serve.preempt`` / ``serve.spill`` (the block-tier preemption path,
+ISSUE 16: a preempt trip degrades that admission back to the plain
+used-token deferral — no victim is touched; a spill trip fires inside
+the gather-to-host boundary BEFORE any pool mutation, so the victim
+falls back to drop-and-re-prefill with the pool intact and its chain
+byte-identical),
 ``serve.loop`` (``ServingEngine`` scheduler thread), ``fleet.route`` /
 ``fleet.probe`` / ``fleet.replica_kill`` (``fleet.Fleet``: a route fault
 degrades that submit to least-queue routing, a probe fault marks the

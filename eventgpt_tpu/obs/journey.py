@@ -9,8 +9,9 @@ one bounded, append-only EVENT TIMELINE per request (Orca / Sarathi
 judge scheduler changes by exactly this decomposition) and derives from
 each finished timeline:
 
-  * a **phase decomposition** — ``queue_s / defer_s / admission_s /
-    decode_s / host_gap_s / failover_redo_s`` — that partitions the
+  * a **phase decomposition** — ``queue_s / defer_s / preempt_s /
+    admission_s / decode_s / host_gap_s / failover_redo_s`` — that
+    partitions the
     request's end-to-end latency exactly (the checkpoints are clamped
     into a monotone chain, so the phases sum to ``t_done - t_submit``
     by construction; property-tested);
@@ -79,6 +80,15 @@ from typing import Any, Dict, List, Optional, Tuple
 #                   crash / unreachable) — the redo failover follows
 #   respawn         the coordinator spawned a replacement process into
 #                   the lost worker's slot while this request was live
+#   preempt         an active row was evicted to admit higher-value
+#                   work (ISSUE 16; mode = spill | drop) — the request
+#                   re-queues and the preempt->resume interval is
+#                   carved out as ``preempt_s``
+#   spill           the victim's KV run was gathered to the host
+#                   SpillStore (bytes + blocks recorded)
+#   restore         a spilled run was scattered back into the arena on
+#                   re-admission (ends the preempt interval; the drop
+#                   path's interval ends at its re-dequeue instead)
 #   nan_quarantine / deadline / cancel   forced-finish markers
 #   exported        the replica drained it for re-admission elsewhere
 #   finish          terminal bookkeeping (status + slo_met)
@@ -86,7 +96,8 @@ EVENT_KINDS = (
     "submit", "queue", "prefix", "mem_guard_defer", "kv_block_defer",
     "lane_join", "lane_finish", "admit", "segment", "spec_depth", "shed",
     "route",
-    "repin", "failover", "worker_lost", "respawn", "nan_quarantine",
+    "repin", "failover", "worker_lost", "respawn", "preempt", "spill",
+    "restore", "nan_quarantine",
     "deadline", "cancel", "exported", "finish",
 )
 
@@ -99,19 +110,24 @@ EVENT_KINDS = (
 # no meaningful time story); ``other`` absorbs degenerate timelines
 # (e2e ~ 0).
 MISS_CAUSES = (
-    "queue", "defer", "admission", "decode", "host_gap",
+    "queue", "defer", "preempt", "admission", "decode", "host_gap",
     "failover_redo", "nan_quarantine", "shed", "other",
 )
 
 # Decomposition keys in checkpoint order (the partition of
-# [t_submit, t_done]; see ``_phases``).
-PHASE_KEYS = ("queue_s", "defer_s", "admission_s", "decode_s",
+# [t_submit, t_done]; see ``_phases``). ``preempt_s`` is carved out of
+# the queue/defer side: a preempted request's wait-to-resume interval
+# lands in queue_s/defer_s under the checkpoint clamps (its re-dequeue
+# overwrites ``t_dequeue``), so the carve re-attributes it without
+# breaking the exact-sum invariant.
+PHASE_KEYS = ("queue_s", "defer_s", "preempt_s", "admission_s", "decode_s",
               "host_gap_s", "failover_redo_s")
 
 
 def _phases(t_submit: float, t_defer: Optional[float],
             t_dequeue: Optional[float], t_admit: Optional[float],
             t_last_commit: Optional[float], t_done: float,
+            preempt_acc: float = 0.0,
             ) -> Dict[str, float]:
     """Partition ``[t_submit, t_done]`` into the phase decomposition.
 
@@ -130,6 +146,13 @@ def _phases(t_submit: float, t_defer: Optional[float],
       host_gap_s   last committed token -> terminal bookkeeping (the
                    finish-side host tail: harvest->finish delay,
                    deadline slack after the final commit)
+      preempt_s    accumulated preempt -> resume wait (ISSUE 16).
+                   A preempted request's wait lands inside
+                   queue_s/defer_s under the clamps (its re-dequeue
+                   overwrote ``t_dequeue``), so this carves
+                   ``min(preempt_acc, defer_s + queue_s)`` back out —
+                   defer_s first, then queue_s — keeping the exact-sum
+                   partition.
       failover_redo_s  0 at this layer; the fleet's stitched view adds
                    the abandoned assignments' wall time here.
     """
@@ -142,12 +165,31 @@ def _phases(t_submit: float, t_defer: Optional[float],
     tc = min(max(tc, ta), td)
     tdef = t_defer if t_defer is not None else tq
     tdef = min(max(tdef, t_submit), tq)
+    queue_s = tdef - t_submit
+    defer_s = tq - tdef
+    host_gap_s = td - tc
+    # Carve the preempt wait out of the phases that absorbed it under
+    # the clamps: defer_s/queue_s when the request resumed (its
+    # re-dequeue overwrote t_dequeue), host_gap_s when it died while
+    # still preempted (t_dequeue stayed at the first dequeue, so the
+    # wait sits past the last commit). Order: defer, queue, host_gap.
+    preempt_s = min(max(float(preempt_acc), 0.0),
+                    queue_s + defer_s + host_gap_s)
+    rem = preempt_s
+    carve = min(rem, defer_s)
+    defer_s -= carve
+    rem -= carve
+    carve = min(rem, queue_s)
+    queue_s -= carve
+    rem -= carve
+    host_gap_s -= rem
     return {
-        "queue_s": tdef - t_submit,
-        "defer_s": tq - tdef,
+        "queue_s": queue_s,
+        "defer_s": defer_s,
+        "preempt_s": preempt_s,
         "admission_s": ta - tq,
         "decode_s": tc - ta,
-        "host_gap_s": td - tc,
+        "host_gap_s": host_gap_s,
         "failover_redo_s": 0.0,
     }
 
@@ -214,6 +256,7 @@ class JourneyRecorder:
             "events": [{"t": float(t), "kind": "submit"}],
             "t_defer": None, "t_dequeue": None, "t_admit": None,
             "t_last_commit": None,
+            "t_preempt": None, "preempt_acc": 0.0,
             "tokens": 0, "segments": 0, "merged": 0,
             "finished": False,
         }
@@ -269,6 +312,18 @@ class JourneyRecorder:
             # header so truncation can never skew the phases).
             if kind == "queue":
                 rec["t_dequeue"] = t
+                if rec["t_preempt"] is not None:
+                    # A preempted request's re-dequeue ends its wait
+                    # (the drop path re-prefills from here; the spill
+                    # path's ``restore`` usually lands first).
+                    rec["preempt_acc"] += t - rec["t_preempt"]
+                    rec["t_preempt"] = None
+            elif kind == "preempt":
+                rec["t_preempt"] = t
+            elif kind == "restore":
+                if rec["t_preempt"] is not None:
+                    rec["preempt_acc"] += t - rec["t_preempt"]
+                    rec["t_preempt"] = None
             elif kind == "admit":
                 rec["t_admit"] = t
             elif kind == "segment":
@@ -312,11 +367,19 @@ class JourneyRecorder:
                 rec["slo_class"] = slo_class
             rec["slo_met"] = slo_met
             rec["e2e_s"] = t_done - rec["t_submit"]
+            preempt_acc = float(rec.get("preempt_acc", 0.0))
+            if rec.get("t_preempt") is not None:
+                # Finished while still preempted (deadline / cancel in
+                # the re-queue): the open interval ends at t_done.
+                preempt_acc += max(t_done - rec["t_preempt"], 0.0)
+                rec["t_preempt"] = None
+                rec["preempt_acc"] = preempt_acc
             rec["phases"] = (dict(phases) if phases is not None
                              else _phases(
                                  rec["t_submit"], rec["t_defer"],
                                  rec["t_dequeue"], rec["t_admit"],
-                                 rec["t_last_commit"], t_done))
+                                 rec["t_last_commit"], t_done,
+                                 preempt_acc))
             rec["cause"] = dominant_cause(rec["status"], rec["phases"])
             ev = {"t": t_done, "kind": "finish", "status": rec["status"]}
             if slo_met is not None:

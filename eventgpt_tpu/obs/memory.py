@@ -59,7 +59,8 @@ from eventgpt_tpu.obs import trace as obs_trace
 # set on purpose: component names become the egpt_mem_component_bytes
 # label values (METRIC_LABELS enum, lint rule 5 — bounded cardinality).
 COMPONENTS = ("weights", "kv_cache", "kv_pool", "kv_block_table", "logits",
-              "ids_buf", "prefix_cache", "lanes", "draft", "carry", "other")
+              "ids_buf", "prefix_cache", "lanes", "draft", "carry", "spill",
+              "other")
 
 
 class MemoryLedger:

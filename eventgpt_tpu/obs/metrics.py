@@ -58,7 +58,8 @@ _INF = float("inf")
 METRIC_LABELS = {
     "egpt_serve_requests_total": {
         "status": ("ok", "deadline_exceeded", "cancelled",
-                   "nan_quarantined", "engine_fault"),
+                   "nan_quarantined", "engine_fault",
+                   "resource_exhausted"),
     },
     "egpt_serve_prefill_dispatches_total": {
         "kind": ("full", "wave", "chunk", "suffix", "suffix_wave",
@@ -74,9 +75,9 @@ METRIC_LABELS = {
                  "procfleet.rpc", "procfleet.spawn",
                  "procfleet.worker_kill", "serve.admit",
                  "serve.dispatch", "serve.loop", "serve.mem_guard",
-                 "serve.mixed_dispatch", "serve.prefix_copy",
-                 "serve.spec_adapt", "serve.step",
-                 "train.step", "other"),
+                 "serve.mixed_dispatch", "serve.preempt",
+                 "serve.prefix_copy", "serve.spec_adapt", "serve.spill",
+                 "serve.step", "train.step", "other"),
         "kind": ("fail", "delay"),
     },
     "egpt_mem_component_bytes": {
@@ -88,7 +89,7 @@ METRIC_LABELS = {
         # with max_batch.
         "component": ("weights", "kv_cache", "kv_pool", "kv_block_table",
                       "logits", "ids_buf", "prefix_cache", "lanes",
-                      "draft", "carry", "other"),
+                      "draft", "carry", "spill", "other"),
     },
     "egpt_fleet_routed_total": {
         # Routing decisions (ISSUE 7): affinity = the session's pinned
@@ -126,8 +127,16 @@ METRIC_LABELS = {
         # rule-5 cross-check asserts equality, this enum enforces at
         # observe time).
         "slo_class": ("interactive", "batch"),
-        "cause": ("queue", "defer", "admission", "decode", "host_gap",
-                  "failover_redo", "nan_quarantine", "shed", "other"),
+        "cause": ("queue", "defer", "preempt", "admission", "decode",
+                  "host_gap", "failover_redo", "nan_quarantine", "shed",
+                  "other"),
+    },
+    "egpt_serve_preemptions_total": {
+        # How a preempted victim's KV left the arena (ISSUE 16): spill =
+        # gathered to the host SpillStore for a byte-exact restore,
+        # drop = released for re-prefill on re-admission (policy choice
+        # or spill-path fallback).
+        "mode": ("spill", "drop"),
     },
     "egpt_alert_active": {
         # The alert evaluator's CLOSED rule enum (obs/series.py
@@ -529,7 +538,8 @@ SERVE_OCCUPANCY = REGISTRY.histogram(
 SERVE_REQUESTS = REGISTRY.counter(
     "egpt_serve_requests_total",
     "Finished requests by terminal status "
-    "(ok / deadline_exceeded / cancelled / nan_quarantined / engine_fault)")
+    "(ok / deadline_exceeded / cancelled / nan_quarantined / "
+    "engine_fault / resource_exhausted)")
 SERVE_TOKENS = REGISTRY.counter(
     "egpt_serve_tokens_total", "Committed (served) tokens")
 SERVE_SEGMENTS = REGISTRY.counter(
@@ -641,9 +651,9 @@ SERVE_SLO_MISS_CAUSE = REGISTRY.counter(
     "egpt_serve_slo_miss_cause_total",
     "SLO-missed finishes by class and the flight recorder's dominant "
     "miss cause (the largest phase of the request's decomposition: "
-    "queue / defer / admission / decode / host_gap / failover_redo, "
-    "plus the non-time causes nan_quarantine / shed / other); counted "
-    "while the recorder is armed (--journey_keep > 0)")
+    "queue / defer / preempt / admission / decode / host_gap / "
+    "failover_redo, plus the non-time causes nan_quarantine / shed / "
+    "other); counted while the recorder is armed (--journey_keep > 0)")
 
 # -- fleet serving: replica supervisor + router (ISSUE 7,
 #    eventgpt_tpu/fleet.py) --
@@ -721,8 +731,9 @@ MEM_COMPONENT = REGISTRY.gauge(
     "egpt_mem_component_bytes",
     "Device bytes the memory ledger attributes to each named component "
     "(weights / kv_cache / kv_pool / kv_block_table / logits / ids_buf "
-    "/ prefix_cache / lanes / draft / carry / other; kv_pool + "
-    "kv_block_table are the paged layout's split of kv_cache)")
+    "/ prefix_cache / lanes / draft / carry / spill / other; kv_pool + "
+    "kv_block_table are the paged layout's split of kv_cache; spill is "
+    "HOST bytes — the pinned spill store tier)")
 MEM_TOTAL = REGISTRY.gauge(
     "egpt_mem_total_bytes",
     "Sum of all ledger-registered device bytes (the accounted side of "
@@ -767,6 +778,29 @@ SERVE_KV_BLOCK_DEFERRALS = REGISTRY.counter(
     "Admissions deferred by the used-token block gate (the queue head's "
     "whole reservation did not fit the free list, even after "
     "reclaiming unpinned prefix entries)")
+
+# -- block-tier preemption + host-RAM KV spill (ISSUE 16,
+#    eventgpt_tpu/serve_blocks.py SpillStore + serve.py preemption) --
+SERVE_PREEMPTIONS = REGISTRY.counter(
+    "egpt_serve_preemptions_total",
+    "Active rows preempted to admit higher-value work, by KV "
+    "disposition (mode=spill: gathered to the host SpillStore for a "
+    "byte-exact restore; mode=drop: released for re-prefill — the "
+    "policy's recompute choice or the spill-path fallback)")
+SERVE_SPILL_BYTES = REGISTRY.counter(
+    "egpt_serve_spill_bytes_total",
+    "KV bytes gathered from the device arena into the host SpillStore "
+    "(restore scatters the same bytes back; drops re-prefill instead)")
+SERVE_RESTORES = REGISTRY.counter(
+    "egpt_serve_restores_total",
+    "Spilled requests whose KV run was scattered back into the arena "
+    "on re-admission (the byte-exact restore path; drop-and-re-prefill "
+    "re-admissions do not count here)")
+SERVE_SPILL_STORE_BYTES = REGISTRY.gauge(
+    "egpt_serve_spill_store_bytes",
+    "Host bytes currently resident in the spill store (bounded by "
+    "--spill_capacity_mb; also priced into the ledger's spill "
+    "component)")
 MEM_COMPILED_TEMP = REGISTRY.gauge(
     "egpt_mem_compiled_temp_bytes",
     "XLA temp allocation of the probed decode/spec segment executable "

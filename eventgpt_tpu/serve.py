@@ -127,6 +127,13 @@ STATUS_OK = "ok"
 STATUS_DEADLINE = "deadline_exceeded"
 STATUS_CANCELLED = "cancelled"
 STATUS_NAN = "nan_quarantined"
+# Both memory tiers exhausted (ISSUE 16): the block pool cannot cover
+# the admission even after the preemption scan AND the host spill store
+# has no budget left — the request is refused NOW (the HTTP layer maps
+# it to 503 + Retry-After) instead of hanging deferred past its
+# deadline. Only raised with preemption armed; defer-only servers keep
+# the pre-16 behavior.
+STATUS_RESOURCE = "resource_exhausted"
 
 # Forced-finish statuses -> the flight-recorder event kind that marks
 # them in the request's timeline (obs/journey.py EVENT_KINDS).
@@ -428,6 +435,38 @@ class PrefixCache:
                 evicted += 1
                 self._release_blocks_locked(victim)
                 obs_metrics.SERVE_PREFIX_EVICTIONS.inc()
+            if evicted:
+                self._export_gauges_locked()
+                obs_memory.LEDGER.resize("prefix_cache", self._mem_key,
+                                         self.bytes)
+        return evicted
+
+    def evict_covering(self, blocks) -> int:
+        """Evict every UNPINNED entry whose block run intersects
+        ``blocks`` — the spill path's targeted sweep (ISSUE 16): an
+        insert-on-prefill entry aliases its creator row's run at ref 2,
+        and the pool refuses to spill a block another owner could still
+        read, so preempting that row first evicts the idle entries
+        riding its blocks (dropping them to ref 1). Pinned entries stay
+        — a pending lane or selected admission is still reading them,
+        and the caller degrades to drop-and-re-prefill. Returns the
+        number of entries evicted."""
+        want = set(blocks)
+        if not want:
+            return 0
+        evicted = 0
+        with self._lock:
+            for node in self._iter_nodes_locked():
+                for key in [k for k, e in node["e"].items()
+                            if e.pins <= 0 and e.blocks
+                            and not want.isdisjoint(e.blocks)]:
+                    victim = node["e"].pop(key)
+                    self.bytes -= victim.nbytes
+                    self.n_entries -= 1
+                    self.evictions += 1
+                    evicted += 1
+                    self._release_blocks_locked(victim)
+                    obs_metrics.SERVE_PREFIX_EVICTIONS.inc()
             if evicted:
                 self._export_gauges_locked()
                 obs_memory.LEDGER.resize("prefix_cache", self._mem_key,
@@ -1507,6 +1546,13 @@ class _Request:
     kv_blocks_owned: List[int] = field(default_factory=list)
     kv_blocks_aliased: List[int] = field(default_factory=list)
     kv_bt_written: bool = False
+    # Block-tier preemption (ISSUE 16): while a preempted request waits
+    # re-queued, ``spill_run`` names its BlockPool spill registry entry
+    # (None = the drop-and-re-prefill path, or never preempted) and the
+    # SpillStore holds its gathered KV under ``rid``. ``preempts``
+    # counts evictions (observability; bench records it per request).
+    spill_run: Optional[int] = None
+    preempts: int = 0
 
 
 class ContinuousBatcher:
@@ -1576,6 +1622,8 @@ class ContinuousBatcher:
         mem_capacity_bytes: int = 0,
         kv_layout: str = "dense",
         kv_pool_blocks: int = 0,
+        preempt: bool = False,
+        spill_capacity_mb: int = 0,
         spec_buckets=None,
         spec_ema_alpha: float = 0.3,
         spec_draft_cost: float = 0.05,
@@ -1815,6 +1863,29 @@ class ContinuousBatcher:
             if self._prefix_cache is not None:
                 # Paged entries pin pool blocks; eviction decrefs them.
                 self._prefix_cache.pool = self._pool
+        # Block-tier preemption + host-RAM KV spill (ISSUE 16): with
+        # ``preempt`` armed (paged layout only), an interactive
+        # admission the free list cannot cover EVICTS the lowest-value
+        # active rows instead of deferring — each victim's KV either
+        # spills to the pinned host store (byte-exact restore through
+        # the paged admission seam) or drops for re-prefill, chosen per
+        # request by measured spill bytes/bandwidth vs recompute FLOPs.
+        # Off by default: the defer-only baseline is unchanged.
+        self.preempt = bool(preempt) and self._paged
+        self._spill_store: Optional[serve_blocks.SpillStore] = None
+        if self._paged:
+            self._spill_store = serve_blocks.SpillStore(
+                max(int(spill_capacity_mb), 0) * (1 << 20),
+                owner=f"b{id(self):x}")
+        self.preemptions = 0
+        # Spill-vs-recompute policy state: device->host bandwidth EWMA
+        # (re-measured at every gather) and the recompute rate seed.
+        # Recompute is priced estimate()-consistently: ~2 * params *
+        # positions FLOPs re-prefilled at the assumed sustained rate.
+        self._spill_bw_Bps = 5e9
+        self._spill_param_count = max(
+            obs_memory.params_bytes(params) // 2, 1)
+        self._recompute_flops_per_s = 5e12
         # Pipelined scheduling (the default): between-segment control state
         # (frozen / n_rem / base_pos) ALSO lives on device, updated
         # in-graph by the segment kernels, so segment N+1 is dispatched
@@ -1960,7 +2031,8 @@ class ContinuousBatcher:
                               ("ids_buf", "ids_buf"),
                               ("draft", "spec_drafts"),
                               ("carry", "carry"),
-                              ("lanes", "lanes")):
+                              ("lanes", "lanes"),
+                              ("spill", "spill")):
                 obs_memory.LEDGER.release(comp, f"{owner}/{key}")
         except Exception:
             pass
@@ -2753,6 +2825,13 @@ class ContinuousBatcher:
                 # device tables reset wholesale below.
                 req.kv_bt_written = False
                 self._paged_release(req)
+                if req.spill_run is not None:
+                    # A spilled request exports like any other: its host
+                    # record drops (the survivor re-decodes from the
+                    # prompt — same byte-identical argument as rows).
+                    self._pool.drop_spilled(req.spill_run)
+                    req.spill_run = None
+                    self._spill_store.drop(req.rid)
             if req.prefix_entry is not None:
                 # Same pin-drain rule as _record_finish: the entry must
                 # not stay unevictable behind a request that left.
@@ -2859,6 +2938,9 @@ class ContinuousBatcher:
         if self._paged:
             s["kv_blocks"] = self._pool.stats()
             s["kv_blocks"]["deferrals"] = self.block_deferrals
+            s["kv_blocks"]["preemptions"] = self.preemptions
+            s["spill"] = self._spill_store.stats()
+            s["spill"]["preempt"] = self.preempt
         return s
 
     def memory_estimate(self) -> Dict[str, Any]:
@@ -3744,6 +3826,13 @@ class ContinuousBatcher:
             # the prefix-pin drain below; freed blocks are what the
             # admission gate hands the next deferred request.
             self._paged_release(req)
+            if req.spill_run is not None:
+                # Died while spilled (deadline in the re-queue, cancel):
+                # the registry entry and the host record drain here —
+                # the one non-restore exit of the spill lifecycle.
+                self._pool.drop_spilled(req.spill_run)
+                req.spill_run = None
+                self._spill_store.drop(req.rid)
         if req.prefix_entry is not None:
             # Drain the refcount pin on EVERY terminal path (EOS, budget,
             # deadline, cancel, quarantine): the entry becomes evictable
@@ -4123,6 +4212,22 @@ class ContinuousBatcher:
             self._prefix_cache.reclaim_blocks(self._pool, need)
             if self._pool.free_blocks() >= need:
                 return True
+        if self.preempt and self._preempt_for(req, need):
+            return True
+        if (self.preempt and req.slo is not None
+                and req.slo.name == "interactive"
+                and self._spill_store.enabled
+                and not self._spill_store.would_fit(
+                    self._pool.block_bytes or 1)):
+            # Both tiers exhausted (ISSUE 16 satellite): the scan found
+            # no victims to cover the head and the host store cannot
+            # take even one more block — refuse NOW with
+            # ``resource_exhausted`` (HTTP 503 + Retry-After) instead
+            # of letting the request hang deferred past its deadline.
+            self.queue.popleft()
+            obs_metrics.SERVE_QUEUE_DEPTH.set(len(self.queue))
+            self._finish_forced(req, STATUS_RESOURCE)
+            return False
         self._paged_defer(req, need)
         return False
 
@@ -4218,6 +4323,268 @@ class ContinuousBatcher:
                     serve_blocks.SCRATCH_BLOCK),
             }
             req.kv_bt_written = False
+
+    # -- block-tier preemption + host-RAM KV spill (ISSUE 16) -------------
+
+    def _preempt_for(self, req, need: int) -> bool:
+        """Preemption scan (the tentpole): evict the lowest-value active
+        rows — batch class only, worst deadline headroom first (a
+        no-deadline row has nothing to miss and goes first) — until the
+        interactive head's ``need`` blocks fit the free list. Never
+        preempts interactive for interactive (thrash), never for batch
+        heads (they defer like today). The ``serve.preempt`` fault site
+        degrades the whole scan back to the plain used-token deferral —
+        no victim is touched on a trip."""
+        if req.slo is None or req.slo.name != "interactive":
+            return False
+        try:
+            faults.maybe_fail("serve.preempt")
+            faults.maybe_delay("serve.preempt")
+        except faults.InjectedFault:
+            return False
+        # Settle any in-flight segment first (the export_requests rule:
+        # rows may only be mutated drained) — the harvest itself can
+        # finish rows and free enough blocks to cover the head.
+        self._drain()
+        if self._pool.free_blocks() >= need:
+            return True
+        now = time.perf_counter()
+        victims = []
+        for r, vic in enumerate(self.rows):
+            if vic is None or self.frozen[r]:
+                continue  # free, lane-reserved or pending rows
+            if vic.slo is not None and vic.slo.name == "interactive":
+                continue
+            headroom = (vic.deadline - now
+                        if vic.deadline is not None else float("-inf"))
+            victims.append((headroom, r, vic))
+        if not victims:
+            return False
+        victims.sort(key=lambda x: (x[0], x[1]))
+        for _, r, vic in victims:
+            if self._pool.free_blocks() >= need:
+                break
+            if self.rows[r] is not vic or self.frozen[r]:
+                continue  # the drain's harvest finished it meanwhile
+            self._preempt_row(vic)
+        return self._pool.free_blocks() >= need
+
+    def _preempt_row(self, vic) -> None:
+        """Evict ONE active row: spill its KV run to the host store when
+        the policy prefers it (falling back to drop on any spill-path
+        failure — fault trip, budget refusal, pinned run), else release
+        the blocks for re-prefill; either way the victim re-queues at
+        the BACK with its committed chain obligation intact (restored
+        byte-exact, or re-decoded from the prompt — greedy chains are
+        deterministic per row, the export_requests argument)."""
+        row = vic.row
+        mode = "spill" if (self._spill_choose(vic)
+                           and self._spill_victim(vic)) else "drop"
+        if mode == "drop":
+            # Re-prefill re-decodes the whole chain from the prompt:
+            # committed tokens are DISCARDED so the re-admission path
+            # (prefill sample + segments) rebuilds them byte-identical.
+            self._paged_release(vic)
+            vic.tokens = []
+        if vic.prefix_entry is not None:
+            self._drain_entry_pin(vic.prefix_entry)
+            vic.prefix_entry = None
+        self.rows[row] = None
+        vic.row = -1
+        self.frozen[row] = True
+        self.n_rem[row] = 0
+        if self.speculative:
+            self.base_pos[row] = 0
+        if self._spec_ctl is not None:
+            self._spec_ctl.forget(vic.rid)
+        # Host row state changed under the device carry: rebuild at the
+        # next dispatch (we are drained — _preempt_for settled it).
+        self._dev_carry = None
+        obs_trace.async_end("active", vic.rid, status="preempted")
+        obs_trace.async_begin("queued", vic.rid)
+        vic.phase = "queued"
+        vic.preempts += 1
+        self.preemptions += 1
+        self.queue.append(vic)
+        obs_metrics.SERVE_QUEUE_DEPTH.set(len(self.queue))
+        obs_metrics.SERVE_ACTIVE_ROWS.set(
+            sum(r is not None for r in self.rows))
+        obs_metrics.SERVE_PREEMPTIONS.inc(mode=mode)
+        obs_journey.event(self._journey_owner, vic.rid, "preempt",
+                          mode=mode, row=row)
+
+    def _spill_choose(self, vic) -> bool:
+        """The spill-vs-recompute policy: spill only an exclusively
+        owned run (aliased/pinned blocks have other owners — the pool
+        would refuse) that fits the host budget, and only when the
+        measured round-trip (bytes out + back at the gather-bandwidth
+        EWMA) undercuts re-prefilling the positions decoded so far
+        (~2 * params * positions FLOPs at the assumed sustained rate —
+        the same closed-form pricing estimate() uses for bytes)."""
+        store = self._spill_store
+        if (store is None or not store.enabled
+                or vic.kv_blocks_aliased or not vic.kv_blocks_owned):
+            return False
+        if any(self._pool.ref(b) != 1 for b in vic.kv_blocks_owned):
+            # Insert-on-prefill aliased part of the run to idle cache
+            # entries (ref 2). Those entries are about to outlive their
+            # creator anyway — evict the unpinned ones covering this run
+            # and re-check; a surviving pin means a live reader, so drop.
+            if self._prefix_cache is not None:
+                self._prefix_cache.evict_covering(vic.kv_blocks_owned)
+            if any(self._pool.ref(b) != 1 for b in vic.kv_blocks_owned):
+                return False
+        nbytes = len(vic.kv_blocks_owned) * (
+            self._pool.block_bytes or self._kv_block_size)
+        if not store.would_fit(nbytes):
+            return False
+        positions = vic.prompt_len + len(vic.tokens)
+        spill_s = 2.0 * nbytes / max(self._spill_bw_Bps, 1.0)
+        recompute_s = (2.0 * self._spill_param_count * positions
+                       / max(self._recompute_flops_per_s, 1.0))
+        return spill_s <= recompute_s
+
+    def _spill_victim(self, vic) -> bool:
+        """Execute one spill, fault-safely ordered: the ``serve.spill``
+        site + the gather + the store admission all happen BEFORE any
+        pool mutation, so a trip or refusal anywhere leaves the pool
+        (and the victim's reservation) exactly as it was and the caller
+        degrades to drop-and-re-prefill."""
+        try:
+            faults.maybe_fail("serve.spill")
+            faults.maybe_delay("serve.spill")
+            rec = self._gather_spill_record(vic)
+        except faults.InjectedFault:
+            return False
+        if not self._spill_store.put(vic.rid, rec, rec["nbytes_kv"]):
+            return False
+        try:
+            run_id = self._pool.spill_out(vic.kv_blocks_owned)
+        except serve_blocks.BlockPoolError:
+            # A pin raced the eligibility check: undo the store record
+            # and drop instead — the pool is untouched (spill_out
+            # validates before mutating).
+            self._spill_store.drop(vic.rid)
+            return False
+        vic.spill_run = run_id
+        vic.kv_blocks_owned = []
+        if vic.kv_bt_written and vic.row >= 0:
+            # Same dead-row rule as _paged_release: the row's table must
+            # point at scratch before its blocks are re-allocated.
+            self.cache = {
+                **self.cache,
+                "bt": self.cache["bt"].at[vic.row].set(
+                    serve_blocks.SCRATCH_BLOCK),
+            }
+            vic.kv_bt_written = False
+        obs_journey.event(self._journey_owner, vic.rid, "spill",
+                          bytes=rec["nbytes_kv"], blocks=rec["n_blocks"])
+        return True
+
+    # egpt-check: harvest -- spill gathers the victim's KV run + row state to host RAM; the preemption boundary is a drained admission decision, outside the pipelined dispatch overlap
+    def _gather_spill_record(self, vic) -> Dict[str, Any]:
+        """The victim's complete re-activation state, gathered dense to
+        host RAM: its block run's KV (the same ``_gather_blocks`` copy
+        ``export_requests``' drain seam and the prefix entries use),
+        cache length, logits row, and the speculative row state
+        (ids_buf / base_pos / medusa drafts). Whole-block copies are
+        byte-exact — attention masks positions past ``length``, so the
+        restore scatter reproduces the row bit-for-bit."""
+        row = vic.row
+        blocks = jnp.asarray(vic.kv_blocks_owned, jnp.int32)
+        if self.mesh is not None:
+            blocks = self._serving.replicate(blocks, self.mesh)
+            fn = _get_sharded_gather_blocks(
+                self._serving.prefix_block_sharding(self.mesh,
+                                                    self.cfg.llama),
+                self.kv_quant,
+            )
+            k, v = fn(self.cache["k"], self.cache["v"], blocks)
+        else:
+            k, v = _gather_blocks_jit(self.cache["k"], self.cache["v"],
+                                      blocks)
+        dev = {"k": k, "v": v, "length": self.cache["length"][row],
+               "logits": self.logits[row]}
+        if self.speculative:
+            dev["ids"] = self.ids_buf[row]
+        if self.draft_head is not None and self.spec_max > 1:
+            dev["drafts"] = self.spec_drafts[row]
+        t0 = time.perf_counter()
+        host = jax.device_get(dev)
+        elapsed = time.perf_counter() - t0
+        nbytes = int(sum(np.asarray(x).nbytes
+                         for x in jax.tree_util.tree_leaves(host)))
+        # Bandwidth EWMA feeding _spill_choose (measured, not assumed).
+        self._spill_bw_Bps = (0.7 * self._spill_bw_Bps
+                              + 0.3 * nbytes / max(elapsed, 1e-6))
+        host["n_blocks"] = len(vic.kv_blocks_owned)
+        host["nbytes_kv"] = nbytes
+        host["base_pos"] = (int(self.base_pos[row])
+                            if self.speculative else 0)
+        return host
+
+    def _paged_restore(self, req, row: int) -> bool:
+        """Re-admit a spilled request (the RESTORE half of the seam):
+        fresh blocks from the pool's spill registry, then the SAME
+        ``_admit_row_paged`` scatter a prefill admission rides — host KV
+        in, block table + length + logits row installed in one donated
+        dispatch. False = the pool cannot cover the run right now (the
+        caller re-queues; the run and the store record stay put)."""
+        rec = self._spill_store.peek(req.rid)
+        if rec is None:  # lifecycle bug — fail loudly, not silently
+            raise serve_blocks.BlockPoolError(
+                f"request {req.rid} has spill_run={req.spill_run} but "
+                f"no spill record")
+        blocks = self._pool.restore(req.spill_run, rec["n_blocks"])
+        if blocks is None:
+            return False
+        self._spill_store.take(req.rid)
+        req.spill_run = None
+        req.kv_blocks_owned = blocks
+        req.kv_blocks_aliased = []
+        dst = jnp.asarray(blocks, jnp.int32)
+        btr = jnp.asarray(self._paged_bt_row(req))
+        row_cache = {"k": rec["k"], "v": rec["v"],
+                     "length": np.asarray([rec["length"]], np.int32)}
+        row_logits = rec["logits"][None]
+        if self.mesh is not None:
+            dst = self._serving.replicate(dst, self.mesh)
+            btr = self._serving.replicate(btr, self.mesh)
+            admit = _get_sharded_admit_paged(
+                self._cache_flat_sh, self._cache_treedef,
+                self._logits_sh)
+        else:
+            admit = _admit_row_paged_jit
+        self.cache, self.logits = admit(
+            self.cache, self.logits, row, dst, btr, row_cache, row_logits
+        )
+        req.kv_bt_written = True
+        self.rows[row] = req
+        req.row = row
+        self.frozen[row] = False
+        self.n_rem[row] = req.max_new_tokens - len(req.tokens)
+        if self.speculative:
+            self.ids_buf = self.ids_buf.at[row].set(
+                jnp.asarray(rec["ids"]))
+            if self.mesh is not None:
+                self.ids_buf = jax.device_put(self.ids_buf, self._ids_sh)
+            self.base_pos[row] = rec["base_pos"]
+        if "drafts" in rec:
+            self.spec_drafts = self.spec_drafts.at[row].set(
+                jnp.asarray(rec["drafts"]))
+            if self.mesh is not None:
+                self.spec_drafts = jax.device_put(
+                    self.spec_drafts, self._drafts_sh)
+        self._dev_carry = None
+        obs_trace.async_end("queued", req.rid)
+        obs_trace.async_begin("active", req.rid)
+        req.phase = "active"
+        obs_metrics.SERVE_RESTORES.inc()
+        obs_metrics.SERVE_ACTIVE_ROWS.set(
+            sum(r is not None for r in self.rows))
+        obs_journey.event(self._journey_owner, req.rid, "restore",
+                          row=row, blocks=rec["n_blocks"])
+        return True
 
     def _drain_entry_pin(self, entry: _PrefixEntry) -> None:
         """Drop one refcount pin; on the LAST drain of a DETACHED paged
@@ -4323,6 +4690,16 @@ class ContinuousBatcher:
             # fail cleanly instead of stranding its waiter.
             self.rows[row] = req
             req.row = row
+            if self._paged and req.spill_run is not None:
+                # A preempted-and-spilled head restores through the
+                # paged admission seam instead of re-prefilling: fresh
+                # blocks + the byte-exact scatter of its gathered KV
+                # (ISSUE 16). The gate pre-checked the same reservation
+                # arithmetic, so failure here is only an eviction race.
+                if self._paged_restore(req, row):
+                    continue
+                self._paged_requeue(req, row)
+                break
             hit = None
             if self._prefix_cache is not None:
                 t0 = time.perf_counter()

@@ -47,6 +47,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List, Optional, Sequence
 
+from eventgpt_tpu.obs import memory as obs_memory
 from eventgpt_tpu.obs import metrics as obs_metrics
 
 # Reserved scratch block: free/finished rows' block tables point here so
@@ -71,14 +72,19 @@ class BlockPool:
     """
 
     # Lock-discipline contract (egpt-check rule ``lock``): the free
-    # list, refcounts and counters only move under the pool lock.
+    # list, refcounts, spill registry and counters only move under the
+    # pool lock.
     _GUARDED_BY = {
         "_free": "_lock",
         "_refs": "_lock",
+        "_spilled": "_lock",
+        "_next_spill_id": "_lock",
         "allocs": "_lock",
         "frees": "_lock",
         "cow_copies": "_lock",
         "alloc_failures": "_lock",
+        "spills": "_lock",
+        "restores": "_lock",
     }
 
     def __init__(self, n_blocks: int, block_size: int,
@@ -102,6 +108,16 @@ class BlockPool:
         self.frees = 0
         self.cow_copies = 0
         self.alloc_failures = 0
+        # Spill registry (ISSUE 16): run_id -> block count of a row's
+        # KV run whose BYTES left the arena for the host-RAM SpillStore.
+        # The device blocks themselves return to the free list at spill
+        # time; the registry only remembers how many blocks the run
+        # needs back so ``restore`` stays a plain allocation with a
+        # loud-failure identity check.
+        self._spilled: Dict[int, int] = {}
+        self._next_spill_id = 0
+        self.spills = 0
+        self.restores = 0
         self._export_gauges_locked()
 
     # -- capacity ---------------------------------------------------------
@@ -201,6 +217,78 @@ class BlockPool:
             self.cow_copies += 1
         obs_metrics.SERVE_KV_COW_COPIES.inc()
 
+    # -- spill / restore (ISSUE 16) ---------------------------------------
+
+    def spill_out(self, blocks: Sequence[int]) -> int:
+        """Evict an EXCLUSIVELY-OWNED block run from the arena: every
+        block must be live at refcount exactly 1 (a pinned / aliased
+        block has another owner whose table would dangle — refused with
+        ``BlockPoolError``, and the caller falls back to
+        drop-and-re-prefill). The blocks return to the free list — the
+        caller has already gathered their bytes to the host — and the
+        returned ``run_id`` names the registry entry ``restore`` checks
+        against. Spilling a block twice fails naturally: the first
+        spill freed it, so ``_check_live_locked`` raises."""
+        blocks = list(blocks)
+        with self._lock:
+            for b in blocks:
+                self._check_live_locked(b)
+                if self._refs[b] != 1:
+                    raise BlockPoolError(
+                        f"block {b} has refcount {self._refs[b]}: "
+                        f"spill-while-pinned refused (an aliased owner "
+                        f"would dangle)")
+            for b in blocks:
+                self._refs[b] = 0
+                self._free.append(b)
+            run_id = self._next_spill_id
+            self._next_spill_id += 1
+            self._spilled[run_id] = len(blocks)
+            self.frees += len(blocks)
+            self.spills += 1
+            self._export_gauges_locked()
+        return run_id
+
+    def restore(self, run_id: int, n: int) -> Optional[List[int]]:
+        """Re-admit a spilled run: ``n`` fresh blocks (the caller
+        scatters the host bytes back through the paged admission seam),
+        or None when the pool cannot cover them yet — the run stays
+        registered and restorable. An unknown / already-restored /
+        dropped ``run_id`` is a lifecycle bug and raises loudly."""
+        with self._lock:
+            if run_id not in self._spilled:
+                raise BlockPoolError(
+                    f"spill run {run_id} is not registered "
+                    f"(already restored, dropped, or never spilled)")
+            n = max(int(n), 0)
+            if n > len(self._free):
+                self.alloc_failures += 1
+                obs_metrics.SERVE_KV_ALLOC_FAILURES.inc()
+                return None
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._refs[b] = 1
+            del self._spilled[run_id]
+            self.allocs += n
+            self.restores += 1
+            self._export_gauges_locked()
+        return out
+
+    def drop_spilled(self, run_id: int) -> None:
+        """Forget a spilled run without restoring it (the victim chose
+        / fell back to re-prefill, or expired). Dropping an unknown run
+        raises — a double drop means two owners thought they held it."""
+        with self._lock:
+            if run_id not in self._spilled:
+                raise BlockPoolError(
+                    f"spill run {run_id} is not registered "
+                    f"(double drop, or already restored)")
+            del self._spilled[run_id]
+
+    def spilled_runs(self) -> int:
+        with self._lock:
+            return len(self._spilled)
+
     def ref(self, block: int) -> int:
         with self._lock:
             return self._refs[block]
@@ -223,6 +311,7 @@ class BlockPool:
         """Snapshot for ``GET /memory`` / bench records (lock-held)."""
         with self._lock:
             free = len(self._free)
+            n_spilled = len(self._spilled)
             return {
                 "n_blocks": self.n_blocks,
                 "block_size": self.block_size,
@@ -234,4 +323,134 @@ class BlockPool:
                 "frees": self.frees,
                 "cow_copies": self.cow_copies,
                 "alloc_failures": self.alloc_failures,
+                "spills": self.spills,
+                "restores": self.restores,
+                "spilled_runs": n_spilled,
+            }
+
+
+class SpillStore:
+    """Pinned host-RAM store for spilled KV runs (ISSUE 16).
+
+    One record per preempted request: the gathered dense KV bytes plus
+    whatever host state re-activation needs (length, logits row, spec
+    ids). A byte BUDGET (``--spill_capacity_mb``) bounds resident host
+    bytes — ``put`` refuses over-budget records (the caller falls back
+    to drop-and-re-prefill) and the refusal count is the exhaustion
+    signal the 503 admission path keys on. Resident bytes are priced
+    into the memory ledger under the ``spill`` component so
+    ``GET /memory`` and the bench records see the host tier next to the
+    device tiers.
+
+    Thread contract: the owning batcher is externally serialized but
+    HTTP handler threads read ``stats()`` — mutations run under
+    ``_lock``. Lock order: SpillStore._lock -> MemoryLedger lock ->
+    metric locks (the ledger resize happens inside the critical
+    section, matching the prefix cache's discipline).
+    """
+
+    _GUARDED_BY = {
+        "_recs": "_lock",
+        "used_bytes": "_lock",
+        "puts": "_lock",
+        "takes": "_lock",
+        "drops": "_lock",
+        "rejects": "_lock",
+    }
+
+    def __init__(self, capacity_bytes: int, owner: str = "spill"):
+        self.capacity_bytes = max(int(capacity_bytes), 0)
+        self._mem_key = f"{owner}/spill"
+        self._lock = threading.Lock()
+        self._recs: Dict[int, Dict[str, Any]] = {}
+        self.used_bytes = 0
+        self.puts = 0
+        self.takes = 0
+        self.drops = 0
+        self.rejects = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity_bytes > 0
+
+    def would_fit(self, nbytes: int) -> bool:
+        with self._lock:
+            return self.capacity_bytes - self.used_bytes >= int(nbytes)
+
+    def put(self, rid: int, record: Dict[str, Any], nbytes: int) -> bool:
+        """Admit one spilled run, or refuse (False) when the budget
+        cannot cover it — never evicts: a spilled run is live request
+        state, not a cache entry."""
+        nbytes = int(nbytes)
+        with self._lock:
+            if rid in self._recs:
+                raise BlockPoolError(
+                    f"request {rid} already holds a spill record "
+                    f"(double spill?)")
+            if nbytes > self.capacity_bytes - self.used_bytes:
+                self.rejects += 1
+                return False
+            record = dict(record)
+            record["nbytes"] = nbytes
+            self._recs[rid] = record
+            self.used_bytes += nbytes
+            self.puts += 1
+            obs_memory.LEDGER.resize("spill", self._mem_key,
+                                     self.used_bytes)
+            obs_metrics.SERVE_SPILL_STORE_BYTES.set(self.used_bytes)
+            obs_metrics.SERVE_SPILL_BYTES.inc(nbytes)
+        return True
+
+    def peek(self, rid: int) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._recs.get(rid)
+
+    def take(self, rid: int) -> Dict[str, Any]:
+        """Remove and return a record (restore succeeded / the caller
+        owns the bytes now). Unknown rids raise — a restore of a run
+        that was never spilled (or already taken) is a lifecycle bug."""
+        with self._lock:
+            rec = self._recs.pop(rid, None)
+            if rec is None:
+                raise BlockPoolError(
+                    f"request {rid} holds no spill record "
+                    f"(double restore, or never spilled)")
+            self.used_bytes -= int(rec["nbytes"])
+            self.takes += 1
+            obs_memory.LEDGER.resize("spill", self._mem_key,
+                                     self.used_bytes)
+            obs_metrics.SERVE_SPILL_STORE_BYTES.set(self.used_bytes)
+        return rec
+
+    def drop(self, rid: int) -> None:
+        """Discard a record without restoring (the victim expired or
+        fell back to re-prefill). Unknown rids are a no-op — drop runs
+        in terminal sweeps that may repeat."""
+        with self._lock:
+            rec = self._recs.pop(rid, None)
+            if rec is None:
+                return
+            self.used_bytes -= int(rec["nbytes"])
+            self.drops += 1
+            obs_memory.LEDGER.resize("spill", self._mem_key,
+                                     self.used_bytes)
+            obs_metrics.SERVE_SPILL_STORE_BYTES.set(self.used_bytes)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._recs.clear()
+            self.used_bytes = 0
+            obs_memory.LEDGER.release("spill", self._mem_key)
+            obs_metrics.SERVE_SPILL_STORE_BYTES.set(0)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "capacity_bytes": self.capacity_bytes,
+                "used_bytes": self.used_bytes,
+                "records": len(self._recs),
+                "puts": self.puts,
+                "takes": self.takes,
+                "drops": self.drops,
+                "rejects": self.rejects,
             }

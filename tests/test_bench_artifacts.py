@@ -525,3 +525,74 @@ def test_compare_bench_handles_workload_records():
         leg["goodput_rps"] = leg["goodput_rps"] * 0.5
     regs, _ = mod.compare(rec, worse)
     assert any("goodput_rps" in r for r in regs)
+
+
+def test_oom_ab_artifact_schema_and_acceptance():
+    """ISSUE 16 acceptance: the checked-in oversubscription A/B
+    (``WORKLOAD_OOM_r0N.json``). At EVERY oversubscription point the
+    preempt+spill arm strictly beats defer-only on goodput and never
+    loses attainment; preemptions actually fired somewhere (the curve
+    is earned, not vacuous); zero BlockPoolErrors; chains byte-identical
+    on both paths; and no spilled run leaked past the replay."""
+    paths = sorted(glob.glob(os.path.join(ROOT, "WORKLOAD_OOM_r0*.json")))
+    assert paths, "no WORKLOAD_OOM_r0*.json checked in"
+    rec = _load(paths[-1])
+    assert rec["metric"].startswith("workload_oom_ab_")
+    assert rec["kv_layout"] == "paged"
+    assert rec["block_pool_errors"] == 0
+    assert rec["chains_identical"] == 1
+    # Trace identity keys ride along (the pairing contract).
+    for k in ("requests", "seed", "arrival", "sessions", "output_min",
+              "output_max", "full_pool_blocks", "spill_capacity_mb"):
+        assert k in rec, k
+    defer = rec["legs"]["defer"]["sweep"]
+    preempt = rec["legs"]["preempt"]["sweep"]
+    assert len(defer) == len(preempt) >= 3
+    total_preempts = total_spills = 0
+    for d, p in zip(defer, preempt):
+        assert d["rate_mult"] == p["rate_mult"]  # same oversub point
+        assert d["pool_blocks"] == p["pool_blocks"]  # same squeeze
+        assert d["pool_blocks"] < rec["full_pool_blocks"]
+        assert d["chains_identical"] and p["chains_identical"]
+        assert p["goodput_rps"] > d["goodput_rps"], (d, p)
+        for cls in ("interactive", "batch"):
+            assert (p["classes"][cls]["attainment"]
+                    >= d["classes"][cls]["attainment"]), (cls, d, p)
+        assert d["preemptions_total"] == 0  # the baseline never evicts
+        assert p["spilled_runs_leaked"] == 0
+        assert p["spill_store"]["used_bytes"] == 0  # all restored/dropped
+        total_preempts += p["preemptions_total"]
+        total_spills += p["spills"]
+    assert total_preempts > 0, "no preemption ever fired: vacuous A/B"
+    assert total_spills > 0, "spill path never exercised"
+    assert rec["value"] > 1.0  # worst-point preempt/defer goodput ratio
+
+
+def test_compare_bench_gates_oom_columns():
+    """The graceful-degradation gate: goodput/attainment on the OOM
+    record pair per oversubscription point and fire on loss;
+    preemptions_total stays informational (a different eviction count
+    is a different schedule, not a regression); chains_identical and
+    preemptions_total are ``--require``-able so the columns can never
+    silently vanish from future rounds."""
+    mod = _compare_mod()
+    paths = sorted(glob.glob(os.path.join(ROOT, "WORKLOAD_OOM_r0*.json")))
+    rec = _load(paths[-1])
+    req = ("preemptions_total", "goodput_rps", "attainment",
+           "chains_identical")
+    regs, _ = mod.compare(rec, rec, require=req)
+    assert regs == [], regs
+    worse = json.loads(json.dumps(rec))
+    for leg in worse["legs"]["preempt"]["sweep"]:
+        leg["goodput_rps"] *= 0.5
+        leg["preemptions_total"] += 40  # policy delta: must NOT gate
+    regs, _ = mod.compare(rec, worse)
+    assert any("goodput_rps" in r for r in regs)
+    assert not any("preemptions_total" in r for r in regs)
+    gone = json.loads(json.dumps(rec))
+    del gone["chains_identical"]
+    for arm in gone["legs"].values():
+        for leg in arm["sweep"]:
+            del leg["chains_identical"]
+    regs, _ = mod.compare(rec, gone, require=("chains_identical",))
+    assert any("not comparable" in r for r in regs)

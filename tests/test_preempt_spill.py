@@ -1,0 +1,423 @@
+"""Block-tier preemption + host-RAM KV spill (ISSUE 16): the BlockPool
+spill/restore registry (pinned-spill refusal, double-spill/double-
+restore loudness, the random-walk refcount property), the SpillStore
+budget/ledger accounting, the preemption scheduling seam (interactive
+heads evict batch rows; interactive rows and batch heads never
+preempt), chain exactness on BOTH degradation paths (spill-restore and
+drop-re-prefill) against unpreempted one-shot runs, the armed
+``serve.preempt`` / ``serve.spill`` chaos drills (rule 4), the journey
+``preempt_s`` phase + miss-cause attribution, and the both-tiers-
+exhausted ``resource_exhausted`` refusal.
+
+The bar is the same as every scheduler change before it: preemption is
+a SCHEDULING decision, never a numerics one — a preempted request's
+greedy chain is byte-identical to its unpreempted run whether its KV
+round-tripped through host RAM or was recomputed from the prompt."""
+
+import jax
+import numpy as np
+import pytest
+
+from eventgpt_tpu import faults
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.models import eventchat
+from eventgpt_tpu.obs import journey as obs_journey
+from eventgpt_tpu.obs import memory as obs_memory
+from eventgpt_tpu.serve import STATUS_RESOURCE, ContinuousBatcher
+from eventgpt_tpu.serve_blocks import (
+    BlockPool, BlockPoolError, SpillStore,
+)
+from eventgpt_tpu.workload import SLO
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disable()
+    yield
+    faults.disable()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(5))
+    return cfg, params
+
+
+def _pv(cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(cfg.num_event_frames, 3, cfg.vision.image_size,
+                            cfg.vision.image_size)).astype(np.float32)
+
+
+BATCH_IDS = [1, 5, -200, 9, 9]
+INTER_IDS = [3, -200, 11, 4]
+BATCH_BUDGET = 40
+INTER_BUDGET = 12
+
+
+def _one_shot(params, cfg, ids, pv, budget, **kw):
+    """The unpreempted reference: one request, ample pool."""
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None, kv_layout="paged",
+                            kv_pool_blocks=12, **kw)
+    rid = srv.submit(ids, pv, budget)
+    return srv.run_until_drained()[rid]
+
+
+def _preempt_scenario(params, cfg, spill_mb, force_spill=True, steps=6,
+                      **kw):
+    """One batch row decoding on an undersized pool, then an
+    interactive arrival that cannot be covered without evicting it."""
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None, kv_layout="paged",
+                            kv_pool_blocks=4, preempt=True,
+                            spill_capacity_mb=spill_mb, **kw)
+    if force_spill and spill_mb:
+        # The closed-form price says recompute on a tiny CPU model;
+        # deflate the assumed rate so the spill arm is exercised.
+        srv._recompute_flops_per_s = 1.0
+    rb = srv.submit(BATCH_IDS, _pv(cfg, 0), BATCH_BUDGET,
+                    slo=SLO(name="batch", latency_s=60.0))
+    for _ in range(steps):
+        srv.step()
+    ri = srv.submit(INTER_IDS, _pv(cfg, 1), INTER_BUDGET,
+                    slo=SLO(name="interactive", ttft_s=30.0))
+    out = srv.run_until_drained()
+    return out, rb, ri, srv
+
+
+def _assert_pool_clean(srv):
+    st = srv._pool.stats()
+    assert st["free_blocks"] + st["used_blocks"] == st["usable_blocks"]
+    assert st["spilled_runs"] == 0
+    if srv._spill_store is not None:
+        assert srv._spill_store.stats()["records"] == 0
+
+
+# -- BlockPool spill registry hardening -------------------------------------
+
+def test_spill_while_pinned_is_refused():
+    pool = BlockPool(8, 64, 1024)
+    run = pool.alloc(3)
+    pool.incref([run[1]])  # an aliased consumer (prefix entry, CoW row)
+    with pytest.raises(BlockPoolError, match="spill-while-pinned"):
+        pool.spill_out(run)
+    # Refusal mutated NOTHING: refcounts and the free list are intact.
+    assert [pool.ref(b) for b in run] == [1, 2, 1]
+    st = pool.stats()
+    assert st["free_blocks"] + st["used_blocks"] == st["usable_blocks"]
+    assert st["spills"] == 0 and st["spilled_runs"] == 0
+
+
+def test_double_spill_and_unknown_runs_raise():
+    pool = BlockPool(8, 64, 1024)
+    run = pool.alloc(3)
+    rid = pool.spill_out(list(run))
+    # The run's blocks went back to the free list: spilling them again
+    # (stale owner, lifecycle bug) is loud, not silent corruption.
+    with pytest.raises(BlockPoolError):
+        pool.spill_out(list(run))
+    with pytest.raises(BlockPoolError, match="not registered"):
+        pool.restore(rid + 999, 3)
+    with pytest.raises(BlockPoolError, match="not registered"):
+        pool.drop_spilled(rid + 999)
+    back = pool.restore(rid, 3)
+    assert len(back) == 3 and all(pool.ref(b) == 1 for b in back)
+    with pytest.raises(BlockPoolError, match="not registered"):
+        pool.restore(rid, 3)  # double restore
+    with pytest.raises(BlockPoolError, match="not registered"):
+        pool.drop_spilled(rid)  # restored runs cannot also be dropped
+
+
+def test_restore_shortage_keeps_run_registered():
+    pool = BlockPool(6, 64, 1024)  # usable 5
+    run = pool.alloc(4)
+    rid = pool.spill_out(run)
+    hog = pool.alloc(4)
+    assert pool.restore(rid, 4) is None  # 1 free < 4: admission defers
+    assert pool.stats()["spilled_runs"] == 1  # run survives the refusal
+    pool.decref(hog)
+    assert len(pool.restore(rid, 4)) == 4
+    assert pool.stats()["spilled_runs"] == 0
+
+
+def test_pool_random_walk_holds_invariants():
+    """Property: any interleaving of alloc / incref / decref /
+    spill_out / restore / drop_spilled keeps refcount and free-count
+    arithmetic exact, and full teardown returns every block."""
+    rng = np.random.default_rng(16)
+    pool = BlockPool(24, 64, 512)
+    live = []      # exclusively-owned runs (ref 1 each)
+    spilled = {}   # run_id -> n blocks
+    for _ in range(400):
+        op = rng.integers(0, 5)
+        if op == 0:
+            n = int(rng.integers(1, 5))
+            run = pool.alloc(n)
+            if run:
+                live.append(run)
+        elif op == 1 and live:
+            run = live.pop(int(rng.integers(0, len(live))))
+            pool.decref(run)
+        elif op == 2 and live:
+            run = live.pop(int(rng.integers(0, len(live))))
+            spilled[pool.spill_out(run)] = len(run)
+        elif op == 3 and spilled:
+            rid = list(spilled)[int(rng.integers(0, len(spilled)))]
+            back = pool.restore(rid, spilled[rid])
+            if back is not None:
+                assert len(back) == spilled.pop(rid)
+                live.append(back)
+        elif op == 4 and spilled:
+            rid = list(spilled)[int(rng.integers(0, len(spilled)))]
+            pool.drop_spilled(rid)
+            del spilled[rid]
+        st = pool.stats()
+        assert st["free_blocks"] + st["used_blocks"] == st["usable_blocks"]
+        assert st["used_blocks"] == sum(len(r) for r in live)
+        assert st["spilled_runs"] == len(spilled)
+        for run in live:
+            assert all(pool.ref(b) == 1 for b in run)
+    for run in live:
+        pool.decref(run)
+    for rid in spilled:
+        pool.drop_spilled(rid)
+    st = pool.stats()
+    assert st["free_blocks"] == st["usable_blocks"]
+    assert st["spilled_runs"] == 0
+
+
+# -- SpillStore accounting ---------------------------------------------------
+
+def test_spill_store_budget_ledger_and_errors():
+    store = SpillStore(1000, owner="t16")
+    assert store.enabled and store.would_fit(1000)
+    assert store.put(1, {"x": 1}, 600)
+    with pytest.raises(BlockPoolError, match="already holds"):
+        store.put(1, {"x": 2}, 10)  # double spill of one rid is loud
+    assert not store.put(2, {"y": 2}, 600)  # over budget: refused
+    st = store.stats()
+    assert st["used_bytes"] == 600 and st["rejects"] == 1
+    # The host bytes are a ledger component ("spill"), not dark RAM.
+    comps = obs_memory.LEDGER.summary()["components"]
+    assert comps.get("spill", 0) >= 600
+    assert store.peek(1) == {"x": 1, "nbytes": 600}
+    assert store.take(1)["x"] == 1
+    with pytest.raises(BlockPoolError):
+        store.take(1)  # double restore
+    store.drop(1)  # terminal sweeps may repeat: drop is idempotent
+    assert store.stats()["used_bytes"] == 0
+    disabled = SpillStore(0, owner="t16b")
+    assert not disabled.enabled and not disabled.would_fit(1)
+    store.clear()
+
+
+# -- preemption: chains byte-identical on both paths ------------------------
+
+@pytest.mark.parametrize("kw", [
+    dict(),
+    dict(kv_quant=True),
+    dict(speculative=4),
+], ids=["plain", "int8_kv", "speculative"])
+def test_preempted_chains_match_one_shot_both_paths(tiny, kw):
+    cfg, params = tiny
+    ref_b = _one_shot(params, cfg, BATCH_IDS, _pv(cfg, 0), BATCH_BUDGET,
+                      **kw)
+    ref_i = _one_shot(params, cfg, INTER_IDS, _pv(cfg, 1), INTER_BUDGET,
+                      **kw)
+    # Spill path: the victim's KV round-trips through host RAM and the
+    # row resumes mid-chain.
+    out, rb, ri, srv = _preempt_scenario(params, cfg, spill_mb=64, **kw)
+    assert srv.preemptions >= 1
+    st = srv._pool.stats()
+    assert st["spills"] >= 1 and st["restores"] >= 1
+    assert out[rb] == ref_b and out[ri] == ref_i
+    _assert_pool_clean(srv)
+    # Drop path: no store — the victim re-prefills from its prompt.
+    out, rb, ri, srv = _preempt_scenario(params, cfg, spill_mb=0, **kw)
+    assert srv.preemptions >= 1
+    assert srv._pool.stats()["spills"] == 0
+    assert out[rb] == ref_b and out[ri] == ref_i
+    _assert_pool_clean(srv)
+
+
+@pytest.mark.parametrize("head,resident", [
+    ("batch", "batch"),
+    ("interactive", "interactive"),
+], ids=["batch_head_defers", "no_interactive_thrash"])
+def test_preemption_spares_interactive_rows_and_batch_heads(tiny, head,
+                                                            resident):
+    """The value ordering is one-directional: only an interactive head
+    may evict, and only batch rows are victims. A batch head defers
+    like the pre-16 policy, and an interactive head never trades one
+    interactive's latency for another's (thrash)."""
+    cfg, params = tiny
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None, kv_layout="paged",
+                            kv_pool_blocks=4, preempt=True,
+                            spill_capacity_mb=64)
+    slo = {"batch": SLO(name="batch", latency_s=120.0),
+           "interactive": SLO(name="interactive", ttft_s=60.0)}
+    r0 = srv.submit(BATCH_IDS, _pv(cfg, 0), BATCH_BUDGET, slo=slo[resident])
+    for _ in range(4):
+        srv.step()
+    r1 = srv.submit(INTER_IDS, _pv(cfg, 1), INTER_BUDGET, slo=slo[head])
+    for _ in range(3):
+        srv.step()
+    assert srv.preemptions == 0 and srv.block_deferrals >= 1
+    out = srv.run_until_drained()
+    assert srv.preemptions == 0
+    assert len(out[r0]) == BATCH_BUDGET and len(out[r1]) == INTER_BUDGET
+    _assert_pool_clean(srv)
+
+
+def test_preempt_victim_order_worst_headroom_first(tiny):
+    """Among batch rows the scan evicts worst deadline headroom first —
+    a row with NO deadline has nothing to miss and goes before one
+    racing a clock — and stops as soon as the head's need is covered."""
+    cfg, params = tiny
+    srv = ContinuousBatcher(params, cfg, max_batch=3, max_len=256, chunk=4,
+                            eos_token_id=None, kv_layout="paged",
+                            kv_pool_blocks=7, preempt=True,
+                            spill_capacity_mb=0)
+    r_dl = srv.submit(BATCH_IDS, _pv(cfg, 0), BATCH_BUDGET,
+                      deadline_s=120.0,
+                      slo=SLO(name="batch", latency_s=120.0))
+    r_nd = srv.submit(BATCH_IDS, _pv(cfg, 1), BATCH_BUDGET,
+                      slo=SLO(name="batch", latency_s=120.0))
+    for _ in range(6):
+        srv.step()
+    # 2 free blocks; 140 new tokens need 3 -> one eviction covers it.
+    ri = srv.submit([3, -200, 11], _pv(cfg, 2), 140,
+                    slo=SLO(name="interactive", ttft_s=60.0))
+    for _ in range(8):
+        srv.step()
+        if srv.preemptions:
+            break
+    assert srv.preemptions == 1
+    queued = [q.rid for q in srv.queue]
+    assert r_nd in queued  # the no-deadline row was the victim
+    assert r_dl not in queued  # one eviction sufficed: the scan stopped
+    active = [r.rid for r in srv.rows if r is not None]
+    assert r_dl in active and ri in active
+    out = srv.run_until_drained()
+    assert len(out[r_dl]) == len(out[r_nd]) == BATCH_BUDGET
+    assert len(out[ri]) == 140
+    _assert_pool_clean(srv)
+
+
+# -- armed chaos drills (rule 4) --------------------------------------------
+
+def test_chaos_spill_trip_degrades_to_drop(tiny):
+    """``serve.spill`` fires INSIDE the gather-to-host boundary, before
+    any pool mutation: the victim falls back to drop-and-re-prefill,
+    the pool holds its invariants, and both chains stay byte-exact."""
+    cfg, params = tiny
+    ref_b = _one_shot(params, cfg, BATCH_IDS, _pv(cfg, 0), BATCH_BUDGET)
+    ref_i = _one_shot(params, cfg, INTER_IDS, _pv(cfg, 1), INTER_BUDGET)
+    faults.configure("serve.spill:n=1")
+    out, rb, ri, srv = _preempt_scenario(params, cfg, spill_mb=64)
+    assert faults.stats()["serve.spill"]["fires"] == 1
+    assert srv.preemptions >= 1
+    assert srv._pool.stats()["spills"] == 0  # the trip forced drop mode
+    assert srv._spill_store.stats()["puts"] == 0
+    assert out[rb] == ref_b and out[ri] == ref_i
+    _assert_pool_clean(srv)
+
+
+def test_chaos_preempt_trip_degrades_to_deferral(tiny):
+    """``serve.preempt`` fires at the scan decision: that admission
+    degrades back to the plain used-token deferral — no victim is
+    touched — and the system keeps serving with chains intact."""
+    cfg, params = tiny
+    ref_b = _one_shot(params, cfg, BATCH_IDS, _pv(cfg, 0), BATCH_BUDGET)
+    ref_i = _one_shot(params, cfg, INTER_IDS, _pv(cfg, 1), INTER_BUDGET)
+    faults.configure("serve.preempt:n=1")
+    out, rb, ri, srv = _preempt_scenario(params, cfg, spill_mb=64)
+    assert faults.stats()["serve.preempt"]["fires"] == 1
+    assert out[rb] == ref_b and out[ri] == ref_i
+    _assert_pool_clean(srv)
+
+
+# -- flight recorder: preempt events, phase carve, miss cause ---------------
+
+def test_journey_records_preempt_spill_restore(tiny):
+    cfg, params = tiny
+    obs_journey.configure(256)
+    try:
+        out, rb, ri, srv = _preempt_scenario(params, cfg, spill_mb=64)
+        j = srv.journey(rb)
+        kinds = [e["kind"] for e in j["events"]]
+        assert "preempt" in kinds and "spill" in kinds
+        assert "restore" in kinds
+        assert j["phases"]["preempt_s"] > 0.0
+        assert sum(j["phases"].values()) == pytest.approx(j["e2e_s"],
+                                                          abs=1e-9)
+    finally:
+        obs_journey.disable()
+
+
+def test_journey_preempt_phase_carve_and_miss_cause():
+    """Synthetic timelines pin the carve arithmetic: preempted wall
+    time comes out of the re-queue wait (never double-counted), an
+    unrestored preemption attributes through to ``t_done``, and a
+    deadline death spent mostly preempted reports cause=preempt."""
+    rec = obs_journey.JourneyRecorder(keep=16)
+    # preempt -> re-dequeue -> re-admit (the resumed request's second
+    # "queue"/"admit" overwrite the checkpoints, so its wait lands in
+    # queue_s under the clamps): the 2.0 s is carved back out as
+    # preempt_s, never double-counted.
+    rec.begin(0, 1, t=10.0)
+    rec.event(0, 1, "queue", t=10.5)
+    rec.event(0, 1, "admit", t=10.6)
+    rec.event(0, 1, "preempt", t=11.0)
+    rec.event(0, 1, "queue", t=13.0)  # re-dequeue ends the wait
+    rec.event(0, 1, "admit", t=13.1)
+    rec.event(0, 1, "segment", t=13.5, tokens=4)
+    out = rec.finish(0, 1, "ok", t_done=14.0)
+    assert out["phases"]["preempt_s"] == pytest.approx(2.0, abs=1e-9)
+    assert out["phases"]["queue_s"] == pytest.approx(1.0, abs=1e-9)
+    assert sum(out["phases"].values()) == pytest.approx(out["e2e_s"],
+                                                        abs=1e-9)
+    # die-while-preempted: the open interval closes at t_done (its wall
+    # time sits past the last commit, so the carve comes out of the
+    # host tail) and dominates the decomposition -> cause "preempt".
+    rec.begin(0, 2, t=0.0)
+    rec.event(0, 2, "queue", t=0.2)
+    rec.event(0, 2, "admit", t=0.3)
+    rec.event(0, 2, "segment", t=0.8, tokens=2)
+    rec.event(0, 2, "preempt", t=1.0)
+    out = rec.finish(0, 2, "deadline_exceeded", t_done=9.0)
+    assert out["phases"]["preempt_s"] == pytest.approx(8.0, abs=1e-9)
+    assert sum(out["phases"].values()) == pytest.approx(out["e2e_s"],
+                                                        abs=1e-9)
+    assert out["cause"] == "preempt"
+    assert "preempt" in obs_journey.MISS_CAUSES
+
+
+# -- both tiers exhausted: loud refusal -------------------------------------
+
+def test_resource_exhausted_when_pool_and_spill_budget_spent(tiny):
+    """Interactive head + no evictable victim + a full spill store:
+    the request is finished ``resource_exhausted`` NOW (the HTTP layer
+    maps it to 503 + Retry-After) instead of deferring forever."""
+    cfg, params = tiny
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                            eos_token_id=None, kv_layout="paged",
+                            kv_pool_blocks=3, preempt=True,
+                            spill_capacity_mb=1)
+    store = srv._spill_store
+    store.put("pad", {}, store.capacity_bytes)  # host budget exhausted
+    r0 = srv.submit(BATCH_IDS, _pv(cfg, 0), 24,
+                    slo=SLO(name="interactive", ttft_s=30.0))
+    for _ in range(2):
+        srv.step()
+    r1 = srv.submit(INTER_IDS, _pv(cfg, 1), 8,
+                    slo=SLO(name="interactive", ttft_s=30.0))
+    out = srv.run_until_drained()
+    assert srv.finish_status[r1] == STATUS_RESOURCE
+    assert out[r1] == []
+    assert srv.finish_status[r0] == "ok" and len(out[r0]) == 24
+    store.drop("pad")
+    _assert_pool_clean(srv)

@@ -10,6 +10,7 @@ import base64
 import json
 import os
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -634,6 +635,77 @@ def test_prefix_route_reuses_kv_and_keeps_chains(tmp_path):
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(bad, timeout=60)
         assert e.value.code == 400
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        engine.shutdown()
+
+
+def test_resource_exhausted_503_with_retry_after(tmp_path):
+    """ISSUE 16 satellite: when BOTH capacity tiers are spent — the
+    block pool cannot cover an interactive admission even by preemption
+    and the host spill budget cannot take one more block — the request
+    comes back 503 ``resource_exhausted`` NOW, carrying the same
+    goodput-derived Retry-After the breaker/shed paths use, instead of
+    hanging deferred past its deadline."""
+    import jax
+
+    from eventgpt_tpu.cli.serve import ServingEngine, make_handler
+    from eventgpt_tpu.config import EventChatConfig
+    from eventgpt_tpu.data.tokenizer import load_tokenizer
+    from eventgpt_tpu.models import eventchat
+    from eventgpt_tpu.serve import ContinuousBatcher
+    from http.server import ThreadingHTTPServer
+
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(0))
+    srv = ContinuousBatcher(params, cfg, max_batch=2, max_len=512, chunk=4,
+                            eos_token_id=None, kv_layout="paged",
+                            kv_pool_blocks=9, preempt=True,
+                            spill_capacity_mb=1)
+    store = srv._spill_store
+    store.put("pad", {}, store.capacity_bytes)  # host tier exhausted
+    engine = ServingEngine(srv, load_tokenizer("byte"))
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0), make_handler(engine, cfg))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        b64 = _tiny_event_b64(tmp_path)
+        hog_out = {}
+
+        def _hog():
+            hog_out["resp"] = _post(
+                url, {"query": "What is happening?", "event_b64": b64,
+                      "max_new_tokens": 150, "slo_class": "interactive"})
+
+        t = threading.Thread(target=_hog)
+        t.start()
+        deadline = time.time() + 60
+        while (not any(r is not None for r in srv.rows)
+               and time.time() < deadline):
+            time.sleep(0.01)
+        active = [r for r in srv.rows if r is not None]
+        assert active
+        # Second interactive head sized (from the resident's measured
+        # prompt) to need the WHOLE pool: no free blocks to cover it,
+        # no batch victim to preempt, no spill headroom -> 503 now.
+        big = 512 - active[0].prompt_len - 2
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            json.dumps({"query": "What is happening?", "event_b64": b64,
+                        "max_new_tokens": big,
+                        "slo_class": "interactive"}).encode(),
+            {"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=120)
+        assert e.value.code == 503
+        body = json.loads(e.value.read())
+        assert body["error"] == "resource_exhausted"
+        assert body["retry_after_s"] > 0
+        assert int(e.value.headers["Retry-After"]) >= 1
+        t.join(timeout=300)
+        assert hog_out["resp"]["tokens"] == 150  # the resident finished
     finally:
         httpd.shutdown()
         httpd.server_close()
