@@ -348,6 +348,39 @@ class ServingEngine:
         self._wake.set()
         return recs
 
+    def collect_handoffs(self) -> List[Dict[str, Any]]:
+        """Drain the prefill-role batcher's handoff outbox (ISSUE 17) —
+        the coordinator pulls these on its probe cadence and ships each
+        to a decode worker. Empty on colocated/decode engines."""
+        with self._lock:
+            b = self.batcher
+            if not hasattr(b, "pop_handoffs"):
+                return []
+            return b.pop_handoffs()
+
+    def import_handoff(self, ids, max_new_tokens: int, rec,
+                       tokens=(), prompt_len: int = 0,
+                       deadline_s=None, slo=None,
+                       elapsed_s: float = 0.0, ttft_s=None) -> int:
+        """Accept a prefill worker's gathered block-run record into the
+        decode-role batcher (ISSUE 17). Same breaker/kill gate as
+        ``submit_ids`` — a degraded decode worker must refuse the ship
+        so the coordinator retries elsewhere instead of stranding KV."""
+        if self.breaker_open() or self._dead:
+            raise RuntimeError(f"serving engine is down: {self.fault}")
+        with self._lock:
+            if self.breaker_open() or self._dead:
+                raise RuntimeError(
+                    f"serving engine is down: {self.fault}")
+            rid = self.batcher.import_handoff(
+                ids, max_new_tokens, rec, tokens=tokens,
+                prompt_len=prompt_len, deadline_s=deadline_s, slo=slo,
+                elapsed_s=elapsed_s, ttft_s=ttft_s)
+            self._done[rid] = threading.Event()
+            self.n_requests += 1
+        self._wake.set()
+        return rid
+
     def revive(self) -> None:
         """Recovery half of ``kill``: the replica re-enters service with
         a clean slate (the kill already swept the batcher) and a closed
@@ -423,6 +456,21 @@ class ServingEngine:
                 "spec": b.spec_stats() if hasattr(b, "spec_stats")
                 else {}}
                if b.speculative else {}),
+            # Disaggregated serving (ISSUE 17): the worker's role, its
+            # block-pool headroom (the decode-placement signal — bytes
+            # compare across a fleet, block counts only within one
+            # geometry) and the staged handoff counters.
+            "role": getattr(b, "role", "colocated"),
+            **({"kv_free_blocks": b._pool.free_blocks(),
+                "kv_free_bytes": b._pool.free_bytes()}
+               if getattr(b, "_pool", None) is not None else {}),
+            **({"handoff": {
+                "pending": len(b.handoff_ready),
+                "gathered": b.handoffs_gathered,
+                "gathered_bytes": b.handoffs_gathered_bytes,
+                "spliced": b.handoffs_spliced,
+                "spliced_bytes": b.handoffs_spliced_bytes}}
+               if hasattr(b, "handoff_ready") else {}),
             # reversed() on a dict view walks newest-first without
             # materializing the (bounded-at-8192) stats map each step.
             "recent": {
@@ -1240,84 +1288,114 @@ def make_handler(engine: ServingEngine, cfg, event_root=None,
     return Handler
 
 
+# Every flag that shapes a worker's batcher/engine MUST cross the
+# process boundary to --worker processes (workers load their own model
+# and build their own engine — separate processes share no state). The
+# forwarding is DECLARED here, not buried in an argv builder, so the
+# regression guard (tests/test_fleet_proc.py::test_worker_argv_*) can
+# assert two things mechanically: (1) every entry round-trips through a
+# fully-populated args namespace, and (2) every parser flag is
+# classified — forwarded, coordinator-only, or per-slot — so a new
+# serving flag cannot silently stay coordinator-side (the bug class
+# that once ran paged-pool workers dense).
+#
+# Kinds: "value"  — always forwarded as --dest str(value);
+#        "opt"    — forwarded only when set (None/empty skipped);
+#        "flag"   — store_true, forwarded only when truthy.
+WORKER_FORWARDED_FLAGS = (
+    ("model_path", "value", "tiny-random"),
+    ("conv_mode", "value", "eventgpt_v1"),
+    ("dtype", "value", "bfloat16"),
+    ("quant", "value", "none"),
+    ("kv_cache", "value", "bf16"),
+    ("kv_layout", "value", "dense"),
+    ("kv_pool_blocks", "value", 0),
+    ("spill_capacity_mb", "value", 0),
+    ("max_batch", "value", 4),
+    ("max_len", "value", 1024),
+    ("chunk", "value", 128),
+    ("temperature", "value", 0.0),
+    ("speculative", "value", 0),
+    ("prefill_chunk", "value", 0),
+    ("prefill_budget", "value", -1),
+    ("first_chunk", "value", 0),
+    ("max_queue", "value", 256),
+    ("prefix_cache_mb", "value", 512.0),
+    ("mem_headroom_mb", "value", 0.0),
+    ("mem_capacity_mb", "value", 0.0),
+    ("breaker_threshold", "value", 3),
+    ("breaker_cooldown_s", "value", 5.0),
+    ("slo_window", "value", 256),
+    ("journey_keep", "value", 512),
+    ("series_interval_s", "value", 1.0),
+    ("series_keep", "value", 512),
+    ("spec_ema_alpha", "value", 0.3),
+    ("spec_draft_cost", "value", 0.05),
+    ("spec_row_window", "value", 4),
+    ("spec_head_min_yield", "value", 0.05),
+    ("spec_buckets", "opt", ""),
+    ("tokenizer_path", "opt", None),
+    ("draft_head", "opt", None),
+    ("preempt", "flag", False),
+    ("fuse_params", "flag", False),
+    ("no_pipeline", "flag", False),
+    ("no_prefix_cache", "flag", False),
+    ("no_telemetry", "flag", False),
+    ("warmup", "flag", False),
+)
+
+# Parser flags that deliberately do NOT cross to workers: the HTTP
+# front-end, fleet topology/policy (the coordinator owns routing), the
+# coordinator-side telemetry sinks, and knobs whose payloads ride the
+# RPC ops instead of argv (SLO targets travel inside each submit's SLO
+# object; --faults crosses via the inherited EGPT_FAULTS env var;
+# --prefix_prompt installs through the set_prefix op). Mesh flags stay
+# here too: a proc-fleet worker owns a single-chip mesh — the
+# multi-host sharded-generate leg is the ROADMAP's open half.
+WORKER_COORDINATOR_ONLY = frozenset({
+    "host", "port", "event_root", "max_body_mb", "max_new_tokens",
+    "default_deadline_s", "prefix_prompt", "prefix_event",
+    "heartbeat_dir",  # per-slot: _spawn appends the slot's own dir
+    "fleet", "proc_fleet", "proc_fleet_roles", "drain_timeout_s",
+    "fleet_shed_goodput", "fleet_shed_queue", "fleet_probe_interval_s",
+    "fleet_heartbeat_stale_s", "fleet_restart_s",
+    "procfleet_rpc_deadline_s", "procfleet_rpc_retries",
+    "procfleet_spawn_timeout_s", "procfleet_respawn_backoff_s",
+    "procfleet_crash_window_s", "procfleet_crash_limit",
+    "procfleet_handoff_retries",
+    "slo_interactive_ttft_s", "slo_interactive_itl_s",
+    "slo_batch_latency_s",
+    "trace_buffer", "trace_out", "profile_dir", "faults",
+    "mesh_data", "mesh_fsdp", "mesh_model",
+    "use_event_qformer", "pretrain_query_embedder",
+    "pretrain_attention_layers",
+})
+
+# Flags the coordinator appends PER SLOT in fleet_proc._spawn (never
+# taken from the coordinator's own namespace): the worker marker, the
+# readiness handshake, the slot index, and the slot's serving role.
+WORKER_PER_SLOT = frozenset({
+    "worker", "worker_ready_file", "worker_slot", "role",
+})
+
+
 def _worker_argv(args) -> list:
-    """The worker process's command line: this coordinator's own model
-    + engine flags, re-serialized behind ``--worker``. Workers load the
-    model themselves (the whole point — separate processes share no
-    state), so every flag that shapes the batcher must cross here."""
+    """The worker process's command line: the coordinator's own model +
+    engine flags, re-serialized behind ``--worker`` from the
+    ``WORKER_FORWARDED_FLAGS`` declaration above."""
     import sys
 
-    argv = [sys.executable, "-m", "eventgpt_tpu.cli.serve", "--worker",
-            "--model_path", args.model_path,
-            "--conv_mode", args.conv_mode,
-            "--dtype", args.dtype,
-            "--quant", args.quant,
-            "--kv_cache", args.kv_cache,
-            "--max_batch", str(args.max_batch),
-            "--max_len", str(args.max_len),
-            "--chunk", str(args.chunk),
-            "--temperature", str(args.temperature),
-            "--speculative", str(args.speculative),
-            "--prefill_chunk", str(args.prefill_chunk),
-            "--prefill_budget", str(getattr(args, "prefill_budget", -1)),
-            "--first_chunk", str(getattr(args, "first_chunk", 0)),
-            "--max_queue", str(getattr(args, "max_queue", 256)),
-            "--prefix_cache_mb", str(getattr(args, "prefix_cache_mb",
-                                             512.0)),
-            "--mem_headroom_mb", str(getattr(args, "mem_headroom_mb",
-                                             0.0)),
-            "--mem_capacity_mb", str(getattr(args, "mem_capacity_mb",
-                                             0.0)),
-            "--breaker_threshold", str(getattr(args, "breaker_threshold",
-                                               3)),
-            "--breaker_cooldown_s", str(getattr(args,
-                                                "breaker_cooldown_s",
-                                                5.0)),
-            "--slo_window", str(getattr(args, "slo_window", 256)),
-            "--journey_keep", str(getattr(args, "journey_keep", 512)),
-            "--series_interval_s", str(getattr(args, "series_interval_s",
-                                               1.0)),
-            "--series_keep", str(getattr(args, "series_keep", 512)),
-            ]
-    if getattr(args, "kv_layout", "dense") != "dense":
-        # Paged pool + preemption tier (ISSUES 15/16): workers own
-        # their pools, so the layout and the degradation policy must
-        # cross the process boundary too (kv_layout previously stayed
-        # coordinator-side, silently running workers dense).
-        argv += ["--kv_layout", str(args.kv_layout),
-                 "--kv_pool_blocks", str(getattr(args, "kv_pool_blocks",
-                                                 0)),
-                 "--spill_capacity_mb",
-                 str(getattr(args, "spill_capacity_mb", 0))]
-        if getattr(args, "preempt", False):
-            argv += ["--preempt"]
-    if getattr(args, "spec_buckets", None):
-        # Adaptive speculation (ISSUE 13): workers run their own
-        # controllers — the policy flags cross the process boundary
-        # like every other batcher-shaping flag.
-        argv += ["--spec_buckets", str(args.spec_buckets),
-                 "--spec_ema_alpha", str(getattr(args, "spec_ema_alpha",
-                                                 0.3)),
-                 "--spec_draft_cost", str(getattr(args, "spec_draft_cost",
-                                                  0.05)),
-                 "--spec_row_window", str(getattr(args, "spec_row_window",
-                                                  4)),
-                 "--spec_head_min_yield",
-                 str(getattr(args, "spec_head_min_yield", 0.05))]
-    if getattr(args, "tokenizer_path", None):
-        argv += ["--tokenizer_path", args.tokenizer_path]
-    if getattr(args, "draft_head", None):
-        argv += ["--draft_head", args.draft_head]
-    if getattr(args, "fuse_params", False):
-        argv += ["--fuse_params"]
-    if getattr(args, "no_pipeline", False):
-        argv += ["--no_pipeline"]
-    if getattr(args, "no_prefix_cache", False):
-        argv += ["--no_prefix_cache"]
-    if getattr(args, "no_telemetry", False):
-        argv += ["--no_telemetry"]
-    if getattr(args, "warmup", False):
-        argv += ["--warmup"]
+    argv = [sys.executable, "-m", "eventgpt_tpu.cli.serve", "--worker"]
+    for dest, kind, default in WORKER_FORWARDED_FLAGS:
+        val = getattr(args, dest, default)
+        if kind == "flag":
+            if val:
+                argv.append(f"--{dest}")
+        elif kind == "opt":
+            if val:
+                argv += [f"--{dest}", str(val)]
+        else:
+            argv += [f"--{dest}", str(val)]
     return argv
 
 
@@ -1382,6 +1460,14 @@ def build_engine(args, force_single: bool = False):
         from eventgpt_tpu.data.tokenizer import load_tokenizer
         from eventgpt_tpu.fleet_proc import ProcFleet
 
+        if (getattr(args, "proc_fleet_roles", None)
+                and getattr(args, "kv_layout", "dense") != "paged"):
+            # Fail HERE, not as a worker crash loop: the handoff moves
+            # block runs, so split roles without the paged layout can
+            # never boot.
+            raise ValueError(
+                "--proc_fleet_roles requires --kv_layout paged (the "
+                "prefill->decode handoff ships paged-KV block runs)")
         if args.model_path == "tiny-random":
             from eventgpt_tpu.config import EventChatConfig
 
@@ -1401,6 +1487,11 @@ def build_engine(args, force_single: bool = False):
         engine = ProcFleet(
             _worker_argv(args), n_proc,
             tokenizer=tokenizer, conv_mode=args.conv_mode,
+            # Prefill/decode disaggregation (ISSUE 17): "P:D" splits the
+            # worker pool into roles; unset = every worker colocated.
+            roles=getattr(args, "proc_fleet_roles", None) or None,
+            handoff_retries=int(getattr(args, "procfleet_handoff_retries",
+                                        3)),
             heartbeat_dir=getattr(args, "heartbeat_dir", None),
             probe_interval_s=getattr(args, "fleet_probe_interval_s",
                                      0.05),
@@ -1481,6 +1572,9 @@ def build_engine(args, force_single: bool = False):
             spec_row_window=int(getattr(args, "spec_row_window", 4)),
             spec_head_min_yield=float(
                 getattr(args, "spec_head_min_yield", 0.05)),
+            # Disaggregated serving role (ISSUE 17): per-worker, set by
+            # the coordinator's _spawn; colocated everywhere else.
+            role=getattr(args, "role", "colocated"),
         )
 
     def _make_engine(batcher, hb_dir):
@@ -1580,7 +1674,11 @@ def build_server(args) -> tuple:
     return httpd, engine
 
 
-def main(argv=None):
+def build_parser() -> argparse.ArgumentParser:
+    """The serving CLI's full argparse surface, separated from main()
+    so the worker-argv regression guard can enumerate every flag and
+    assert it is classified (WORKER_FORWARDED_FLAGS /
+    WORKER_COORDINATOR_ONLY / WORKER_PER_SLOT)."""
     p = argparse.ArgumentParser()
     p.add_argument("--model_path", default="tiny-random")
     p.add_argument("--tokenizer_path", default=None)
@@ -1745,6 +1843,26 @@ def main(argv=None):
                         "Separate failure domains: a worker death is "
                         "drained/redone onto survivors and the slot "
                         "respawns with backoff")
+    p.add_argument("--proc_fleet_roles", default=None,
+                   help="prefill/decode disaggregation (ISSUE 17): "
+                        "'P:D' splits the --proc_fleet workers into P "
+                        "prefill-role workers (admission only; each "
+                        "activated row's paged-KV block run is gathered "
+                        "and shipped) and D decode-role workers (splice "
+                        "the shipped run into their own arena and "
+                        "decode). P+D must equal --proc_fleet; requires "
+                        "--kv_layout paged. Unset = every worker "
+                        "colocated (the default, unchanged). Greedy "
+                        "chains are byte-identical either way")
+    p.add_argument("--procfleet_handoff_retries", type=int, default=3,
+                   help="decode workers a shipped handoff is tried "
+                        "against before the coordinator falls back to "
+                        "REDO (re-submit from its own record)")
+    p.add_argument("--role", default="colocated",
+                   choices=["colocated", "prefill", "decode"],
+                   help="this worker's serving role (set per slot by "
+                        "the --proc_fleet_roles coordinator; not a "
+                        "user-facing flag)")
     p.add_argument("--worker", action="store_true",
                    help="run as one process-fleet worker: build a "
                         "single engine and serve the length-prefixed "
@@ -1856,6 +1974,11 @@ def main(argv=None):
     p.add_argument("--use_event_qformer", action="store_true")
     p.add_argument("--pretrain_query_embedder", default=None)
     p.add_argument("--pretrain_attention_layers", default=None)
+    return p
+
+
+def main(argv=None):
+    p = build_parser()
     args = p.parse_args(argv)
 
     if args.worker:

@@ -63,7 +63,12 @@ bounded-backoff retry loop must absorb, a spawn trip fails that worker
 spawn attempt — booked as a crash, so the respawn-backoff/crash-loop
 policy governs it — and a worker_kill trip IS the scripted SIGKILL of
 the busiest worker, whose requests the coordinator must redo on
-survivors), ``multiproc.launch``
+survivors), ``procfleet.handoff`` (the prefill->decode KV handoff ship
+boundary, ISSUE 17: a trip is a transport failure mid-ship — the
+coordinator retries the import boundedly against other decode workers
+and then falls back to the REDO path, never double-splicing — the
+decode worker's import dedup key makes a retried ship idempotent),
+``multiproc.launch``
 / ``multiproc.worker`` (``parallel/multiproc.py`` bootstrap), and
 ``train.step`` (``Trainer`` micro-batch boundary).
 

@@ -69,6 +69,30 @@ cumulative delta + the terminal sentinel). Nothing leaves the process
 before the request is terminal, which is exactly why — unlike the
 in-process fleet — streamed requests CAN fail over here.
 
+Prefill/decode disaggregation (ISSUE 17): ``--proc_fleet_roles P:D``
+splits the fleet into PREFILL workers (chunked/batched admission only
+— their scheduler never dispatches a decode segment) and DECODE
+workers. New requests route to the prefill pool (prefix affinity
+unchanged — the radix caches live where the prompts land); when a
+prefill worker finishes admission it gathers the request's paged block
+run (the PR 16 spill record: block-table-named KV at SEQ_BUCKET grain
++ int8 scale planes + sampling state + the closed prefill-leg journey)
+into a handoff outbox. The coordinator's supervisor pumps that outbox:
+``collect_handoffs`` pulls records over the raw-binary RPC frame (KV
+bytes ride verbatim, no b64 inflation), ``import_handoff`` ships each
+to the decode worker with the most free block-pool bytes, and
+``ack_handoffs`` releases the prefill side's replay copy only after
+the ship lands. Every ship attempt probes the ``procfleet.handoff``
+fault site; a failed attempt retries against other decode workers
+(bounded by ``handoff_retries``) and then falls back to the REDO path
+— never a double splice: the decode handler dedups imports on the
+coordinator-assigned ``hid`` token, so a retried ship whose first ack
+was lost re-serves the same worker rid. Greedy chains are
+byte-identical to a colocated run (the splice rides the same paged
+admission executable). Journeys stitch THREE legs from durations:
+prefill phases + ``handoff_s`` (coordinator collect->import wall time)
++ decode phases + ``failover_redo_s``, exact-sum as ever.
+
 A jax-free STUB worker (``python -m eventgpt_tpu.fleet_proc
 --stub_worker``) serves the same RPC surface over a deterministic fake
 engine, so the coordinator's spawn/retry/respawn/crash-loop logic is
@@ -87,6 +111,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from eventgpt_tpu import faults, rpc
 from eventgpt_tpu.fleet import affinity_key
@@ -116,18 +142,28 @@ class WorkerHandler:
 
     Ops: submit_ids / try_result / try_results / try_status / cancel /
     export_requests / snapshot / stats / memory / journey / set_prefix /
-    reset_stats / ping / shutdown.
+    reset_stats / ping / shutdown / collect_handoffs / ack_handoffs /
+    import_handoff.
 
     ``try_result`` is made IDEMPOTENT here: the engine pops a delivered
     answer, so a retried poll whose first response was lost would find
     nothing and the request would hang forever. Delivered results are
     kept in a bounded replay cache so the retry re-serves the same
     record (the coordinator-side dedup key is the rid).
+
+    The handoff ops get the same treatment from both sides (ISSUE 17):
+    ``collect_handoffs`` parks popped records in ``_handoff_unacked``
+    and re-serves them until ``ack_handoffs`` — a collect response lost
+    to a transport fault replays instead of stranding KV; and
+    ``import_handoff`` dedups on the coordinator-assigned ``hid`` in a
+    bounded ``_imported`` cache, so a retried ship whose first response
+    was lost returns the original rid instead of splicing twice.
     """
 
-    # Lock discipline (egpt-check rule ``lock``): the replay cache is
+    # Lock discipline (egpt-check rule ``lock``): the replay caches are
     # written from concurrent RPC connection threads.
-    _GUARDED_BY = {"_delivered": "_lock"}
+    _GUARDED_BY = {"_delivered": "_lock", "_handoff_unacked": "_lock",
+                   "_imported": "_lock"}
 
     REPLAY_CAP = 4096
 
@@ -136,6 +172,8 @@ class WorkerHandler:
         self.stop_event = threading.Event()
         self._lock = threading.Lock()
         self._delivered: Dict[int, dict] = {}
+        self._handoff_unacked: Dict[int, dict] = {}
+        self._imported: Dict[str, int] = {}
 
     def _result_record(self, rid: int) -> Optional[dict]:
         with self._lock:
@@ -227,6 +265,54 @@ class WorkerHandler:
             except Exception:
                 pass  # stub worker: no ledger to reset
             return True
+        if op == "collect_handoffs":
+            # Prefill role: drain the engine's outbox into the replay
+            # dict, then serve EVERYTHING unacked — a coordinator whose
+            # previous collect response was lost sees the same records
+            # again (delivery is at-least-once; the decode side's hid
+            # dedup makes the re-ship idempotent).
+            fresh = (eng.collect_handoffs()
+                     if hasattr(eng, "collect_handoffs") else [])
+            now = time.perf_counter()
+            with self._lock:
+                for rec in fresh:
+                    self._handoff_unacked[int(rec["rid"])] = rec
+                out = []
+                for rec in self._handoff_unacked.values():
+                    # Refresh elapsed_s with the outbox wait at every
+                    # serve (stored record untouched — replays refresh
+                    # again), and keep the worker-local stamp off the
+                    # wire: only durations cross processes.
+                    wire = {k: v for k, v in rec.items()
+                            if k != "t_gather"}
+                    if rec.get("t_gather") is not None:
+                        wire["elapsed_s"] = (
+                            (rec.get("elapsed_s") or 0.0)
+                            + (now - rec["t_gather"]))
+                    out.append(wire)
+                return out
+        if op == "ack_handoffs":
+            with self._lock:
+                for rid in p["rids"]:
+                    self._handoff_unacked.pop(int(rid), None)
+            return True
+        if op == "import_handoff":
+            hid = str(p["hid"])
+            with self._lock:
+                if hid in self._imported:
+                    return self._imported[hid]
+            rid = eng.import_handoff(
+                list(p["input_ids"]), int(p["max_new_tokens"]), p["rec"],
+                tokens=list(p.get("tokens") or ()),
+                prompt_len=int(p.get("prompt_len", 0)),
+                deadline_s=p.get("deadline_s"), slo=p.get("slo"),
+                elapsed_s=float(p.get("elapsed_s") or 0.0),
+                ttft_s=p.get("ttft_s"))
+            with self._lock:
+                self._imported[hid] = rid
+                while len(self._imported) > self.REPLAY_CAP:
+                    self._imported.pop(next(iter(self._imported)))
+            return rid
         if op == "shutdown":
             self.stop_event.set()
             return True
@@ -289,12 +375,24 @@ class _StubEngine:
     ``[(sum(ids) + k) % 251 for k in range(budget)]`` after
     ``token_delay_s`` per token — the same function in every process,
     so coordinator failover tests can assert chain identity without
-    paying a jax import. Used by ``--stub_worker`` mode only."""
+    paying a jax import. Used by ``--stub_worker`` mode only.
 
-    _GUARDED_BY = {"_reqs": "_lock", "_done": "_lock"}
+    Role support (ISSUE 17): a ``prefill`` stub "admits" a request in
+    one ``token_delay_s`` and moves it to the handoff outbox with a
+    deterministic ndarray "KV" payload (the input ids verbatim — it
+    crosses the raw-binary RPC frame, and the decode stub REJECTS a
+    corrupted array, so stub fleet tests assert bit-exact transport);
+    a ``decode`` stub's ``import_handoff`` enqueues the request like a
+    submit, finishing with the SAME chain function — byte-identical to
+    a colocated stub run."""
 
-    def __init__(self, token_delay_s: float = 0.005):
+    _GUARDED_BY = {"_reqs": "_lock", "_done": "_lock",
+                   "_handoffs": "_lock"}
+
+    def __init__(self, token_delay_s: float = 0.005,
+                 role: str = "colocated"):
         self.token_delay_s = float(token_delay_s)
+        self.role = role
         self.batcher = _StubBatcher()
         self.alive = True
         self.n_faults = 0
@@ -303,6 +401,9 @@ class _StubEngine:
         self._next_rid = 0
         self._reqs: Dict[int, dict] = {}   # live: rid -> record
         self._done: Dict[int, tuple] = {}  # finished: rid -> (toks, st)
+        self._handoffs: List[dict] = []    # prefill role: the outbox
+        self.handoffs_gathered = 0
+        self.handoffs_spliced = 0
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -333,12 +434,68 @@ class _StubEngine:
                 if not self.alive:
                     continue
                 for rid, r in list(self._reqs.items()):
+                    if self.role == "prefill":
+                        # Admission-only: one token_delay_s of "prefill"
+                        # moves the request to the outbox — never a
+                        # decode. The fake KV plane is the ids verbatim
+                        # (int32), so the raw-frame transport is
+                        # asserted bit-exact at the decode stub.
+                        if now - r["t0"] < self.token_delay_s:
+                            continue
+                        self._reqs.pop(rid)
+                        self.handoffs_gathered += 1
+                        self._handoffs.append({
+                            "rid": rid,
+                            "input_ids": list(r["ids"]),
+                            "tokens": [],
+                            "max_new_tokens": r["budget"],
+                            "prompt_len": len(r["ids"]),
+                            "deadline_s": r["deadline_s"],
+                            "slo": r["slo"],
+                            "preempts": 0,
+                            "journey": None,
+                            "rec": {
+                                "n_blocks": 1, "n_total": 1,
+                                "length": len(r["ids"]),
+                                "nbytes_kv": 4 * len(r["ids"]),
+                                "kv": np.asarray(r["ids"], np.int32),
+                            },
+                        })
+                        continue
                     if now - r["t0"] >= self.token_delay_s * r["budget"]:
                         self._reqs.pop(rid)
                         self._done[rid] = (
                             self._chain(r["ids"], r["budget"]), "ok")
                         self.batcher.request_stats[rid] = {
                             "latency_s": now - r["t0"], "slo_met": True}
+
+    def collect_handoffs(self) -> List[dict]:
+        with self._lock:
+            out, self._handoffs = self._handoffs, []
+            return out
+
+    def import_handoff(self, input_ids, max_new_tokens, rec,
+                       tokens=(), prompt_len=0, deadline_s=None,
+                       slo=None, elapsed_s=0.0, ttft_s=None) -> int:
+        if not self.alive:
+            raise RuntimeError("stub engine is down (killed)")
+        kv = rec.get("kv")
+        if kv is not None and np.asarray(kv).tolist() != \
+                [int(t) for t in input_ids]:
+            # The transport contract IS the test: a handoff whose KV
+            # plane didn't survive the raw frame bit-exact must refuse
+            # the splice, not decode garbage.
+            raise ValueError("stub handoff KV plane corrupted in transit")
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            self.handoffs_spliced += 1
+            self._reqs[rid] = {
+                "rid": rid, "ids": list(input_ids), "pixels": None,
+                "budget": int(max_new_tokens), "t0": time.perf_counter(),
+                "deadline_s": deadline_s, "slo": slo,
+            }
+        return rid
 
     def try_result(self, rid):
         with self._lock:
@@ -370,8 +527,21 @@ class _StubEngine:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {"active_rows": len(self._reqs), "queued": 0,
-                    "slo": {}, "memory": {}}
+            return {
+                "active_rows": len(self._reqs), "queued": 0,
+                "slo": {}, "memory": {}, "role": self.role,
+                # Fake pool headroom that shrinks with load, so the
+                # decode-placement policy is exercised at stub speed.
+                "kv_free_bytes": (1 << 20) - 4096 * len(self._reqs),
+                "kv_free_blocks": 256 - len(self._reqs),
+                "handoff": {
+                    "pending": len(self._handoffs),
+                    "gathered": self.handoffs_gathered,
+                    "gathered_bytes": 0,
+                    "spliced": self.handoffs_spliced,
+                    "spliced_bytes": 0,
+                },
+            }
 
     def stats(self) -> dict:
         return {"stub": True, **self.snapshot()}
@@ -407,11 +577,14 @@ def _stub_main(argv=None) -> int:
     p.add_argument("--worker_slot", type=int, default=0)
     p.add_argument("--heartbeat_dir", default=None)
     p.add_argument("--token_delay_s", type=float, default=0.005)
+    p.add_argument("--role", default="colocated",
+                   choices=("colocated", "prefill", "decode"))
     args = p.parse_args(argv)
     # A real (tiny) time-series store per stub worker: the aggregation
     # tests assert over genuine sampled rings, not canned dicts.
     obs_series.configure(interval_s=0.02, keep=256)
-    engine = _StubEngine(token_delay_s=args.token_delay_s)
+    engine = _StubEngine(token_delay_s=args.token_delay_s,
+                         role=args.role)
     if args.heartbeat_dir:
         from eventgpt_tpu.train.resilience import Heartbeat
 
@@ -458,6 +631,13 @@ class _ProcRequest:
     status: str = "ok"
     stats: Dict[str, float] = field(default_factory=dict)
     stream_q: Any = None
+    # Disaggregation (ISSUE 17): the closed prefill-leg phase
+    # decomposition (rides the handoff record) and the coordinator-
+    # measured collect->import wall time — both stitched into the final
+    # journey. Reset on failover: a REDO restarts the whole chain, and
+    # only the FINAL chain's legs may sum into the timeline.
+    prefill_phases: Optional[Dict[str, float]] = None
+    handoff_s: float = 0.0
 
 
 @dataclass
@@ -471,6 +651,9 @@ class WorkerSlot:
     idx: int
     proc: Optional[subprocess.Popen] = None
     addr: Optional[Tuple[str, int]] = None
+    # colocated | prefill | decode (fixed at fleet construction: a
+    # slot's role survives respawn — the topology is static)
+    role: str = "colocated"
     # starting | ok | suspect | draining | dead | failed
     state: str = "starting"
     generation: int = 0                # spawn attempts (ready-file key)
@@ -564,6 +747,10 @@ class ProcFleet:
         "n_respawns": "_lock/w",
         "n_kills": "_lock/w",
         "n_crash_looped": "_lock/w",
+        "n_handoffs": "_lock/w",
+        "n_handoff_bytes": "_lock/w",
+        "n_handoff_retries": "_lock/w",
+        "n_handoff_redos": "_lock/w",
         "fault": "_lock/w",
     }
 
@@ -582,9 +769,35 @@ class ProcFleet:
                  crash_window_s: float = 60.0,
                  crash_limit: int = 3,
                  max_failovers: int = 3,
-                 shutdown_drain_s: float = 30.0):
+                 shutdown_drain_s: float = 30.0,
+                 roles: Optional[str] = None,
+                 handoff_retries: int = 3):
         if n_workers < 1:
             raise ValueError("a process fleet needs at least one worker")
+        # Disaggregated topology (ISSUE 17): "P:D" fixes the first P
+        # slots as prefill workers, the rest as decode. None keeps
+        # every slot colocated — the default topology, byte-for-byte
+        # the pre-disaggregation fleet.
+        self.roles: Optional[Tuple[int, int]] = None
+        if roles:
+            p_str, sep, d_str = str(roles).partition(":")
+            try:
+                if not sep:
+                    raise ValueError(roles)
+                n_p, n_d = int(p_str), int(d_str)
+            except ValueError:
+                raise ValueError(
+                    f"bad proc_fleet_roles {roles!r} (want P:D, e.g. 1:1)")
+            if n_p < 1 or n_d < 1:
+                raise ValueError(
+                    f"proc_fleet_roles {roles!r}: a disaggregated fleet "
+                    f"needs at least one prefill AND one decode worker")
+            if n_p + n_d != n_workers:
+                raise ValueError(
+                    f"proc_fleet_roles {roles!r}: {n_p}+{n_d} workers "
+                    f"!= fleet size {n_workers}")
+            self.roles = (n_p, n_d)
+        self.handoff_retries = int(handoff_retries)
         self.worker_cmd = list(worker_cmd)
         self.tokenizer = tokenizer
         self.conv_mode = conv_mode
@@ -623,6 +836,15 @@ class ProcFleet:
         self.n_respawns = 0
         self.n_kills = 0
         self.n_crash_looped = 0
+        self.n_handoffs = 0
+        self.n_handoff_bytes = 0
+        self.n_handoff_retries = 0
+        self.n_handoff_redos = 0
+        # Serializes collect->ship->ack per pump pass: the supervisor's
+        # periodic pump and a drain's flush pump must not ship the same
+        # replayed record concurrently (the hid dedup would still
+        # prevent a double splice, but the bookkeeping would race).
+        self._pump_lock = threading.Lock()
         self.fault: Any = None
         self._journey_owner = obs_journey.register_owner("procfleet")
         self.slots = [self._make_slot(i) for i in range(n_workers)]
@@ -638,7 +860,10 @@ class ProcFleet:
     def _make_slot(self, idx: int) -> WorkerSlot:
         hb = (os.path.join(self.heartbeat_root, f"replica{idx}")
               if self.heartbeat_root else None)
-        return WorkerSlot(idx=idx, hb_dir=hb,
+        role = "colocated"
+        if self.roles is not None:
+            role = "prefill" if idx < self.roles[0] else "decode"
+        return WorkerSlot(idx=idx, hb_dir=hb, role=role,
                           log_path=os.path.join(self.workdir,
                                                 f"worker{idx}.log"))
 
@@ -655,6 +880,8 @@ class ProcFleet:
             "--worker_ready_file", slot.ready_file,
             "--worker_slot", str(slot.idx),
         ]
+        if slot.role != "colocated":
+            cmd += ["--role", slot.role]
         if slot.hb_dir:
             cmd += ["--heartbeat_dir", slot.hb_dir]
         try:
@@ -813,7 +1040,16 @@ class ProcFleet:
     def breaker_open(self) -> bool:
         """The fleet refuses work only when NO worker is routable —
         one healthy worker keeps /health green (lost capacity shows in
-        egpt_procfleet_workers_routable instead)."""
+        egpt_procfleet_workers_routable instead). A disaggregated
+        fleet needs one routable worker of EACH role: a prefill-only
+        fleet can admit but never decode, a decode-only fleet can
+        never admit."""
+        if self.roles is not None:
+            return not (
+                any(s.routable and s.role == "prefill"
+                    for s in self.slots)
+                and any(s.routable and s.role == "decode"
+                        for s in self.slots))
         return not any(s.routable for s in self.slots)
 
     def goodput_ratio(self) -> float:
@@ -979,10 +1215,17 @@ class ProcFleet:
             per.append({
                 "worker": slot.idx,
                 "state": slot.state,
+                "role": slot.role,
                 "pid": slot.proc.pid if slot.proc else None,
                 "active_rows": s.get("active_rows", 0),
                 "queued": s.get("queued", 0),
                 "inflight": slot.inflight,
+                # Disaggregation surface (ISSUE 17): block-pool
+                # headroom (the decode-placement signal) and the
+                # worker-side handoff counters from the last probe.
+                "kv_free_blocks": s.get("kv_free_blocks"),
+                "kv_free_bytes": s.get("kv_free_bytes"),
+                "handoff": s.get("handoff") or {},
                 "faults": s.get("n_faults", 0),
                 "restarts": s.get("n_restarts", 0),
                 "crashes": len(slot.crashes),
@@ -1016,6 +1259,26 @@ class ProcFleet:
                 "respawns": self.n_respawns,
                 "kills": self.n_kills,
                 "crash_looped": self.n_crash_looped,
+                # Role topology + handoff totals (ISSUE 17): None/0s
+                # on a colocated fleet — the shape is stable so /fleet
+                # consumers need no feature detection.
+                "roles": (f"{self.roles[0]}:{self.roles[1]}"
+                          if self.roles is not None else None),
+                "handoffs": {
+                    "shipped": self.n_handoffs,
+                    "bytes": self.n_handoff_bytes,
+                    "retries": self.n_handoff_retries,
+                    "redos": self.n_handoff_redos,
+                    "gathered": sum(
+                        (p["handoff"] or {}).get("gathered", 0)
+                        for p in per),
+                    "spliced": sum(
+                        (p["handoff"] or {}).get("spliced", 0)
+                        for p in per),
+                    "pending": sum(
+                        (p["handoff"] or {}).get("pending", 0)
+                        for p in per),
+                },
                 "per_worker": per,
             },
             "metrics": obs_metrics.REGISTRY.summary(
@@ -1051,6 +1314,7 @@ class ProcFleet:
                 "crash_window_s": self.crash_window_s,
                 "crash_limit": self.crash_limit,
                 "max_failovers": self.max_failovers,
+                "handoff_retries": self.handoff_retries,
             },
         }
 
@@ -1147,6 +1411,10 @@ class ProcFleet:
             self.n_deaths = 0
             self.n_respawns = 0
             self.n_kills = 0
+            self.n_handoffs = 0
+            self.n_handoff_bytes = 0
+            self.n_handoff_retries = 0
+            self.n_handoff_redos = 0
         for slot in self.slots:
             if not slot.routable:
                 continue
@@ -1190,6 +1458,11 @@ class ProcFleet:
                 out.append((ev.get("worker"), ev.get("worker_rid")))
             elif ev.get("kind") == "failover":
                 out.append((ev.get("to_worker"), ev.get("worker_rid")))
+            elif (ev.get("kind") == "kv_handoff"
+                    and ev.get("stage") == "shipped"):
+                # The decode leg of a disaggregated request is a real
+                # assignment: its worker holds the continued timeline.
+                out.append((ev.get("to_worker"), ev.get("worker_rid")))
         return out
 
     # -- routing -----------------------------------------------------------
@@ -1197,19 +1470,45 @@ class ProcFleet:
     def _route_locked(self, key: tuple, exclude=()) -> tuple:
         """(slot, reason): the key's pinned worker while routable, else
         least coordinator-tracked inflight (snapshot queue depths lag a
-        probe tick; the coordinator's own assignment count does not)."""
+        probe tick; the coordinator's own assignment count does not).
+        Disaggregated fleets route new submissions to the PREFILL pool
+        only — prefix affinity keys prefill placement, where the radix
+        caches actually serve prompt heads."""
         pool = [s for s in self.slots
-                if s.routable and s.idx not in exclude]
+                if s.routable and s.idx not in exclude
+                and (self.roles is None or s.role == "prefill")]
         if not pool:
             raise RuntimeError(
-                f"no routable worker ({len(self.slots)} slot(s)): "
-                f"{self.fault}")
+                f"no routable{' prefill' if self.roles else ''} worker "
+                f"({len(self.slots)} slot(s)): {self.fault}")
         pinned = self._pins.get(key)
         if pinned is not None and pinned not in exclude \
-                and self.slots[pinned].routable:
+                and self.slots[pinned].routable \
+                and (self.roles is None
+                     or self.slots[pinned].role == "prefill"):
             return self.slots[pinned], "affinity"
         return (min(pool, key=lambda s: (s.inflight, s.idx)),
                 "least_queue")
+
+    def _route_decode_locked(self, exclude=()) -> Optional[WorkerSlot]:
+        """Decode placement balances BLOCK-POOL HEADROOM, not queue
+        depth: the splice must re-allocate the request's full paged
+        reservation, so the worker with the most free KV bytes (from
+        its last probe snapshot; coordinator-tracked inflight breaks
+        ties) takes the next handoff. None when no decode worker is
+        currently routable — the caller keeps the record replayable."""
+        pool = [s for s in self.slots
+                if s.routable and s.role == "decode"
+                and s.idx not in exclude]
+        if not pool:
+            return None
+
+        def headroom(s: WorkerSlot):
+            snap = s.snapshot or {}
+            return (snap.get("kv_free_bytes")
+                    or snap.get("kv_free_blocks") or 0)
+
+        return min(pool, key=lambda s: (-headroom(s), s.inflight, s.idx))
 
     # -- supervision -------------------------------------------------------
 
@@ -1244,6 +1543,12 @@ class ProcFleet:
         with self._lock:
             self.n_kills += 1
         self._export_routable_gauge()
+        if slot.role == "prefill":
+            # Flush the handoff outbox BEFORE the export: gathered
+            # records are neither queued nor in-flight on this worker
+            # any more (the gather tore the row down), so the export
+            # would miss them and their KV would die with the process.
+            self._pump_slot_handoffs(slot)
         try:
             exported = self._rpc(slot, "export_requests",
                                  deadline_s=self.drain_deadline_s)
@@ -1253,6 +1558,34 @@ class ProcFleet:
             self._on_worker_lost(slot, f"worker {idx} unreachable "
                                        f"during drain", graceful=False)
             return 0
+        if slot.role == "prefill":
+            # Once more after the export parked the scheduler: a row
+            # gathered between the first flush and the park would
+            # otherwise strand. Nothing can gather after this (the
+            # engine is parked), so the outbox is now final.
+            self._pump_slot_handoffs(slot)
+            # Anything STILL unacked could not ship (e.g. no decode
+            # worker routable right now). Its KV dies with this
+            # process — REDO each owner from the coordinator record
+            # rather than stranding it behind the graceful-drain
+            # "finished but uncollected" skip below.
+            try:
+                left = self._rpc(slot, "collect_handoffs",
+                                 deadline_s=10.0)
+            except rpc.RpcError:
+                left = []
+            with self._lock:
+                for out in left or []:
+                    freq = next(
+                        (f for f in self._requests.values()
+                         if f.worker == slot.idx
+                         and f.rid == int(out["rid"])
+                         and not f.done.is_set()), None)
+                    if freq is None:
+                        continue
+                    remaining = (freq.deadline - time.perf_counter()
+                                 if freq.deadline is not None else None)
+                    self._failover_locked(freq, remaining, "redo")
         moved = self._on_worker_lost(
             slot, f"worker {idx} drained", graceful=True,
             exported=exported or [])
@@ -1316,18 +1649,29 @@ class ProcFleet:
 
     def _failover_locked(self, freq: _ProcRequest,
                          deadline_s: Optional[float],
-                         path: str) -> bool:
+                         path: str,
+                         avoid_current: bool = True) -> bool:
         """Re-route one request to a surviving worker (caller holds the
         lock). The session's pin MOVES with it. Returns True when the
-        request found a new home."""
+        request found a new home. In a disaggregated fleet the REDO
+        pool is the PREFILL side regardless of where the request died:
+        a lost decode worker took the spliced KV with it, so the only
+        way forward is a fresh prefill -> handoff chain (greedy chains
+        are deterministic per request — the re-run is byte-identical).
+
+        ``avoid_current=False`` keeps the request's CURRENT worker in
+        the candidate pool: a handoff-ship failure redoes from a
+        healthy prefill worker — excluding it (the rule for a dying
+        worker) would dead-end a 1-prefill fleet for no reason."""
         freq.failovers += 1
         if freq.failovers > self.max_failovers:
             self._finish_locked(freq, None, "engine_fault")
             return False
-        tried = {freq.worker}
+        tried = {freq.worker} if avoid_current else set()
         while True:
             pool = [s for s in self.slots
-                    if s.routable and s.idx not in tried]
+                    if s.routable and s.idx not in tried
+                    and (self.roles is None or s.role == "prefill")]
             if not pool:
                 self._finish_locked(freq, None, "engine_fault")
                 return False
@@ -1353,6 +1697,11 @@ class ProcFleet:
         freq.worker = slot.idx
         freq.rid = rid
         freq.t_assign = time.perf_counter()
+        # The abandoned attempt's prefill/handoff legs must not sum
+        # into the final timeline — their wall time is exactly what
+        # failover_redo_s charges (t_submit -> this assignment).
+        freq.prefill_phases = None
+        freq.handoff_s = 0.0
         slot.inflight += 1
         self._pins[freq.key] = slot.idx
         self.n_failovers += 1
@@ -1372,7 +1721,12 @@ class ProcFleet:
         DURATIONS (worker clocks are not comparable): the final
         assignment's worker-measured phases + ``failover_redo_s`` =
         coordinator wall time from first submit to the final
-        assignment. The phase-sum invariant holds by construction.
+        assignment. A disaggregated request stitches THREE legs: the
+        prefill worker's closed phase decomposition (rides the handoff
+        record) sums keywise into the decode leg's, ``handoff_s`` is
+        the coordinator-measured collect->import wall time, and
+        ``failover_redo_s`` covers any abandoned chains before the
+        final one. The phase-sum invariant holds by construction.
         When the worker timeline is unavailable (its recorder
         disarmed, or the worker is gone) a failed-over request still
         charges redo honestly — the final leg's unattributed time
@@ -1380,18 +1734,30 @@ class ProcFleet:
         redo = (max(freq.t_assign - freq.t_submit, 0.0)
                 if freq.failovers else 0.0)
         if worker_journey is None or not worker_journey.get("phases"):
-            if not freq.failovers:
+            if not freq.failovers and not freq.handoff_s:
                 return None
             t_done = time.perf_counter()
             phases = {k: 0.0 for k in obs_journey.PHASE_KEYS}
-            phases["decode_s"] = max(t_done - freq.t_submit - redo, 0.0)
+            phases["handoff_s"] = freq.handoff_s
+            phases["decode_s"] = max(
+                t_done - freq.t_submit - redo - freq.handoff_s, 0.0)
             phases["failover_redo_s"] = redo
             return freq.t_submit, t_done, phases
         phases = dict(worker_journey["phases"])
-        phases["failover_redo_s"] = redo
         leg_e2e = sum(v for k, v in worker_journey["phases"].items()
-                      if k != "failover_redo_s")
-        return freq.t_submit, freq.t_submit + redo + leg_e2e, phases
+                      if k not in ("failover_redo_s", "handoff_s"))
+        prefill_e2e = 0.0
+        if freq.prefill_phases:
+            for k, v in freq.prefill_phases.items():
+                if k in ("failover_redo_s", "handoff_s"):
+                    continue
+                phases[k] = phases.get(k, 0.0) + v
+                prefill_e2e += v
+        phases["handoff_s"] = freq.handoff_s
+        phases["failover_redo_s"] = redo
+        t_done = (freq.t_submit + redo + prefill_e2e
+                  + freq.handoff_s + leg_e2e)
+        return freq.t_submit, t_done, phases
 
     def _finish_locked(self, freq: _ProcRequest, tokens, status: str,
                        worker_journey: Optional[dict] = None) -> None:
@@ -1447,6 +1813,7 @@ class ProcFleet:
                         victim = max(pool,
                                      key=lambda s: (s.inflight, -s.idx))
                         self.kill_worker(victim.idx)
+                self._pump_handoffs()
                 self._collect()
                 self._export_routable_gauge()
             except Exception as e:  # defensive: supervision must survive
@@ -1544,6 +1911,165 @@ class ProcFleet:
                     self._finish_locked(freq, rec["tokens"],
                                         rec["status"],
                                         worker_journey=rec.get("journey"))
+
+    # -- prefill/decode handoff pump (ISSUE 17) ----------------------------
+
+    def _pump_handoffs(self) -> None:
+        """Move gathered block runs from prefill outboxes to decode
+        arenas (supervisor tick). Delivery is at-least-once end to end:
+        unacked records replay from the prefill worker, the decode
+        worker's hid dedup absorbs the duplicates."""
+        if self.roles is None:
+            return
+        for slot in self.slots:
+            if slot.role != "prefill" or slot.addr is None:
+                continue
+            if slot.state not in ("ok", "draining"):
+                continue
+            self._pump_slot_handoffs(slot)
+
+    def _pump_slot_handoffs(self, slot: WorkerSlot) -> None:
+        """One collect -> ship* -> ack pass over ``slot``'s outbox
+        (serialized by ``_pump_lock``: the supervisor's periodic pump
+        and a drain's flush must not ship the same replayed record
+        concurrently)."""
+        with self._pump_lock:
+            try:
+                recs = self._rpc(slot, "collect_handoffs",
+                                 deadline_s=self.rpc_deadline_s)
+            except rpc.RpcError:
+                return  # probe handles slot health; records replay
+            acked: List[int] = []
+            for out in recs or []:
+                try:
+                    if self._ship_handoff(slot, out):
+                        acked.append(int(out["rid"]))
+                except Exception as e:  # defensive: one bad record
+                    acked.append(int(out["rid"]))  # must not wedge
+                    with self._lock:              # the whole outbox
+                        self.fault = f"handoff ship failed: {e!r}"
+            if acked:
+                try:
+                    self._rpc(slot, "ack_handoffs", {"rids": acked},
+                              deadline_s=10.0)
+                except rpc.RpcError:
+                    pass  # re-served next collect; hid dedup absorbs
+
+    def _ship_handoff(self, src: WorkerSlot, out: dict) -> bool:
+        """Ship one gathered record to a decode worker. True = the
+        record is settled at the source (shipped, stale, or fallen
+        back to REDO) and can be acked; False keeps it replayable
+        (transient: no decode worker reachable right now). Each
+        attempt probes the ``procfleet.handoff`` fault site — a trip
+        is a transport failure mid-ship that the bounded retry loop
+        must absorb without ever double-splicing."""
+        src_rid = int(out["rid"])
+        with self._lock:
+            freq = next(
+                (f for f in self._requests.values()
+                 if f.worker == src.idx and f.rid == src_rid
+                 and not f.done.is_set()), None)
+        if freq is None:
+            return True  # stale replay: the request moved on already
+        # The spawn generation is part of the identity: a respawned
+        # prefill worker's engine rid counter restarts at 0, so a bare
+        # slot:rid pair would collide with a pre-respawn record still
+        # sitting in a decode worker's dedup cache — the import would
+        # "dedup" onto a long-finished stranger's rid.
+        hid = f"{src.idx}.{src.generation}:{src_rid}"
+        rec = out.get("rec") or {}
+        nbytes = int(rec.get("nbytes_kv", 0))
+        n_blocks = int(rec.get("n_blocks", 0))
+        t0 = time.perf_counter()
+        tried: set = set()
+        attempts = 0
+        rid2 = None
+        dslot = None
+        while attempts < max(self.handoff_retries, 1):
+            with self._lock:
+                dslot = self._route_decode_locked(exclude=tried)
+            if dslot is None:
+                break
+            attempts += 1
+            try:
+                faults.maybe_fail("procfleet.handoff")
+                faults.maybe_delay("procfleet.handoff")
+                rid2 = self._rpc(
+                    dslot, "import_handoff",
+                    {"hid": hid,
+                     "input_ids": out["input_ids"],
+                     "tokens": out.get("tokens") or [],
+                     "max_new_tokens": out["max_new_tokens"],
+                     "prompt_len": out.get("prompt_len", 0),
+                     "deadline_s": out.get("deadline_s"),
+                     "slo": out.get("slo"),
+                     "elapsed_s": out.get("elapsed_s"),
+                     "ttft_s": out.get("ttft_s"),
+                     "rec": rec},
+                    retry_sent=False)
+                break
+            except (faults.InjectedFault, rpc.RpcError,
+                    rpc.RpcRemoteError) as e:
+                rid2 = None
+                tried.add(dslot.idx)
+                with self._lock:
+                    self.n_handoff_retries += 1
+                    self.fault = (f"handoff {hid} -> worker "
+                                  f"{dslot.idx}: {e!r}")
+                if isinstance(e, rpc.RpcError):
+                    dslot.state = "suspect"
+                    self._export_routable_gauge()
+        if rid2 is None:
+            if attempts == 0:
+                return False  # no decode worker up: keep it replayable
+            # Retries exhausted: the REDO fallback — re-prefill from
+            # the coordinator's own record. Never a double splice: no
+            # import succeeded, so the shipped KV reached no arena.
+            with self._lock:
+                if freq.done.is_set() or freq.worker != src.idx:
+                    return True
+                self.n_handoff_redos += 1
+                deadline_s = (freq.deadline - time.perf_counter()
+                              if freq.deadline is not None else None)
+                # The source prefill worker is HEALTHY (the failure was
+                # on the decode side): keep it in the redo pool.
+                self._failover_locked(freq, deadline_s, "redo",
+                                      avoid_current=False)
+            return True
+        dt = time.perf_counter() - t0
+        with self._lock:
+            already = (freq.worker == dslot.idx and freq.rid == rid2)
+            moved = freq.done.is_set() or freq.worker != src.idx
+            if not moved:
+                src.inflight = max(src.inflight - 1, 0)
+                freq.worker = dslot.idx
+                freq.rid = int(rid2)
+                freq.prefill_phases = ((out.get("journey") or {})
+                                       .get("phases") or None)
+                freq.handoff_s += dt
+                dslot.inflight += 1
+                self.n_handoffs += 1
+                self.n_handoff_bytes += nbytes
+        if moved:
+            if not already:
+                # The request finished/failed over while we shipped:
+                # the import is an orphan — cancel it best-effort (a
+                # missed cancel decodes into the replay cache and ages
+                # out; it can never double-deliver).
+                try:
+                    self._rpc(dslot, "cancel", {"rid": int(rid2)},
+                              deadline_s=5.0)
+                except rpc.RpcError:
+                    pass
+            return True
+        obs_metrics.PROCFLEET_HANDOFFS.inc(stage="shipped")
+        obs_metrics.PROCFLEET_HANDOFF_BYTES.inc(nbytes)
+        obs_metrics.PROCFLEET_HANDOFF_SECONDS.observe(dt)
+        obs_journey.event(self._journey_owner, freq.frid, "kv_handoff",
+                          stage="shipped", from_worker=src.idx,
+                          to_worker=dslot.idx, worker_rid=int(rid2),
+                          bytes=nbytes, blocks=n_blocks)
+        return True
 
     def _export_routable_gauge(self) -> None:
         obs_metrics.PROCFLEET_ROUTABLE.set(

@@ -89,6 +89,12 @@ from typing import Any, Dict, List, Optional, Tuple
 #   restore         a spilled run was scattered back into the arena on
 #                   re-admission (ends the preempt interval; the drop
 #                   path's interval ends at its re-dequeue instead)
+#   kv_handoff      the paged prefill->decode handoff (ISSUE 17): one
+#                   event per stage — ``gathered`` (prefill worker
+#                   pulled the block run to host), ``shipped``
+#                   (coordinator moved it to a decode worker over RPC),
+#                   ``spliced`` (decode worker scattered it into its
+#                   arena) — with bytes + block count
 #   nan_quarantine / deadline / cancel   forced-finish markers
 #   exported        the replica drained it for re-admission elsewhere
 #   finish          terminal bookkeeping (status + slo_met)
@@ -97,7 +103,7 @@ EVENT_KINDS = (
     "lane_join", "lane_finish", "admit", "segment", "spec_depth", "shed",
     "route",
     "repin", "failover", "worker_lost", "respawn", "preempt", "spill",
-    "restore", "nan_quarantine",
+    "restore", "kv_handoff", "nan_quarantine",
     "deadline", "cancel", "exported", "finish",
 )
 
@@ -111,7 +117,7 @@ EVENT_KINDS = (
 # (e2e ~ 0).
 MISS_CAUSES = (
     "queue", "defer", "preempt", "admission", "decode", "host_gap",
-    "failover_redo", "nan_quarantine", "shed", "other",
+    "failover_redo", "handoff", "nan_quarantine", "shed", "other",
 )
 
 # Decomposition keys in checkpoint order (the partition of
@@ -121,7 +127,7 @@ MISS_CAUSES = (
 # overwrites ``t_dequeue``), so the carve re-attributes it without
 # breaking the exact-sum invariant.
 PHASE_KEYS = ("queue_s", "defer_s", "preempt_s", "admission_s", "decode_s",
-              "host_gap_s", "failover_redo_s")
+              "host_gap_s", "failover_redo_s", "handoff_s")
 
 
 def _phases(t_submit: float, t_defer: Optional[float],
@@ -155,6 +161,10 @@ def _phases(t_submit: float, t_defer: Optional[float],
                    partition.
       failover_redo_s  0 at this layer; the fleet's stitched view adds
                    the abandoned assignments' wall time here.
+      handoff_s    0 at this layer; the fleet's stitched view charges
+                   the prefill->decode KV move (gather + RPC ship +
+                   splice wait) here from coordinator-measured
+                   durations (ISSUE 17).
     """
     td = t_done
     tq = t_dequeue if t_dequeue is not None else td
@@ -191,6 +201,7 @@ def _phases(t_submit: float, t_defer: Optional[float],
         "decode_s": tc - ta,
         "host_gap_s": host_gap_s,
         "failover_redo_s": 0.0,
+        "handoff_s": 0.0,
     }
 
 

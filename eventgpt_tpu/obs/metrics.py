@@ -72,7 +72,7 @@ METRIC_LABELS = {
         # synthetic/ad-hoc drill sites (faults._site_label clamps).
         "site": ("fleet.probe", "fleet.replica_kill", "fleet.route",
                  "multiproc.launch", "multiproc.worker",
-                 "procfleet.rpc", "procfleet.spawn",
+                 "procfleet.handoff", "procfleet.rpc", "procfleet.spawn",
                  "procfleet.worker_kill", "serve.admit",
                  "serve.dispatch", "serve.loop", "serve.mem_guard",
                  "serve.mixed_dispatch", "serve.preempt",
@@ -128,8 +128,18 @@ METRIC_LABELS = {
         # observe time).
         "slo_class": ("interactive", "batch"),
         "cause": ("queue", "defer", "preempt", "admission", "decode",
-                  "host_gap", "failover_redo", "nan_quarantine", "shed",
-                  "other"),
+                  "host_gap", "failover_redo", "handoff",
+                  "nan_quarantine", "shed", "other"),
+    },
+    "egpt_procfleet_handoff_total": {
+        # Prefill->decode KV handoff stages (ISSUE 17): gathered = the
+        # prefill worker pulled the block run to host RAM, shipped =
+        # the coordinator moved it to a decode worker over RPC,
+        # spliced = the decode worker scattered it into its arena.
+        # gathered/spliced increment in the worker processes' own
+        # registries, shipped in the coordinator's; /stats aggregates
+        # the fleet-wide totals from the handoff counters instead.
+        "stage": ("gathered", "shipped", "spliced"),
     },
     "egpt_serve_preemptions_total": {
         # How a preempted victim's KV left the arena (ISSUE 16): spill =
@@ -725,6 +735,23 @@ PROCFLEET_CRASH_LOOPS = REGISTRY.counter(
     "Worker slots the crash-loop breaker gave up on (K crashes inside "
     "the window): capacity degrades, /health stays green while any "
     "other worker is routable")
+PROCFLEET_HANDOFFS = REGISTRY.counter(
+    "egpt_procfleet_handoff_total",
+    "Prefill->decode KV handoffs by stage (ISSUE 17): gathered (block "
+    "run pulled to host on the prefill worker), shipped (moved to a "
+    "decode worker over the raw-binary RPC frame), spliced (scattered "
+    "into the decode worker's arena); per-process registries — "
+    "gathered/spliced count in the workers, shipped in the coordinator")
+PROCFLEET_HANDOFF_BYTES = REGISTRY.counter(
+    "egpt_procfleet_handoff_bytes_total",
+    "Bytes of gathered KV handoff records shipped prefill->decode "
+    "(coordinator-side; the raw-frame payload, KV planes + scales + "
+    "row state, b64-free on the wire)")
+PROCFLEET_HANDOFF_SECONDS = REGISTRY.histogram(
+    "egpt_procfleet_handoff_seconds",
+    "Coordinator wall time to move one handoff record: collect from "
+    "the prefill worker through import acknowledged by the decode "
+    "worker (the stitched handoff_s phase sums these durations)")
 
 # -- HBM memory ledger (ISSUE 9, eventgpt_tpu/obs/memory.py) --
 MEM_COMPONENT = REGISTRY.gauge(

@@ -45,6 +45,22 @@ identity tests depend on pixels surviving verbatim), bytes as
 ``{"__b64__": ...}``, and the ``workload.SLO`` dataclass as
 ``{"__slo__": {...}}`` (an allowlisted type, not arbitrary class
 hydration). Deliberately jax-free.
+
+Raw-binary frame (ISSUE 17): a paged-KV handoff record is megabytes of
+ndarray, and riding it through ``__nd__`` costs ~33% b64 inflation plus
+a full JSON parse of the blob. ``dumps_frame``/``loads_frame`` add a
+TAGGED alternative encoding of the same value space: when a message
+contains ndarrays, the frame becomes ``b"EGRB" + u32(header_len) +
+header_json + raw_blob_bytes`` — the header is ordinary RPC JSON with
+each array replaced by ``{"__blob__": i, "shape", "dtype"}`` and a blob
+length table, and the arrays' raw bytes are concatenated after it
+(length-prefixed by the table; byte-exact round trip, tested).
+Blob-free messages fall back to the plain JSON encoding verbatim, and
+``loads_frame`` dispatches on the magic prefix, so both frame forms
+interoperate on one socket and old-format peers keep working. ``call``
+and ``RpcServer`` use the frame codec symmetrically — every op
+(submit pixels, export_requests, the KV handoff) gets the raw path
+for free.
 """
 
 from __future__ import annotations
@@ -93,8 +109,11 @@ def _enc_default(o):
     import numpy as np
 
     if isinstance(o, np.ndarray):
+        # list(o.shape), not the contiguous copy's: ascontiguousarray
+        # promotes 0-d to 1-d (ndmin=1), which would silently turn a
+        # scalar leaf (a cache length, a base_pos) into shape (1,).
         arr = np.ascontiguousarray(o)
-        return {"__nd__": [list(arr.shape), str(arr.dtype),
+        return {"__nd__": [list(o.shape), str(arr.dtype),
                            base64.b64encode(arr.tobytes()).decode()]}
     if isinstance(o, np.integer):
         return int(o)
@@ -136,6 +155,90 @@ def dumps(obj: Any) -> bytes:
 
 def loads(data: bytes) -> Any:
     return json.loads(data.decode(), object_hook=_dec_hook)
+
+
+# -- raw-binary frame (ISSUE 17) -------------------------------------------
+#
+# Layout:  RAW_MAGIC | u32 header_len | header JSON | blob 0 | blob 1 | ...
+# header = {"h": <payload with arrays as __blob__ refs>, "b": [len, ...]}
+# The magic cannot collide with the JSON form (which always starts with
+# "{", 0x7B), so one recv path decodes both.
+
+RAW_MAGIC = b"EGRB"
+_BLOB_KEYS = frozenset(("__blob__", "shape", "dtype"))
+
+
+def _extract_blobs(o: Any, blobs: list) -> Any:
+    import numpy as np
+
+    if isinstance(o, np.ndarray):
+        # Same 0-d rule as _enc_default: the shape comes from ``o``,
+        # not the ndmin=1 contiguous copy.
+        arr = np.ascontiguousarray(o)
+        blobs.append(arr.tobytes())
+        return {"__blob__": len(blobs) - 1,
+                "shape": list(o.shape), "dtype": str(arr.dtype)}
+    if isinstance(o, dict):
+        return {k: _extract_blobs(v, blobs) for k, v in o.items()}
+    if isinstance(o, (list, tuple)):
+        return [_extract_blobs(v, blobs) for v in o]
+    return o
+
+
+def dumps_frame(obj: Any) -> bytes:
+    """Encode ``obj`` for the wire: the raw-binary frame when it
+    carries ndarrays (their bytes ride verbatim after the JSON header
+    — no b64 inflation), the plain JSON encoding otherwise."""
+    blobs: list = []
+    header_obj = _extract_blobs(obj, blobs)
+    if not blobs:
+        return dumps(obj)
+    header = json.dumps(
+        {"h": header_obj, "b": [len(b) for b in blobs]},
+        default=_enc_default).encode()
+    return b"".join([RAW_MAGIC, _LEN.pack(len(header)), header] + blobs)
+
+
+def _restore_blobs(o: Any, blobs: list) -> Any:
+    import numpy as np
+
+    if isinstance(o, dict):
+        if _BLOB_KEYS.issuperset(o) and "__blob__" in o:
+            # .copy(): writable, owns its memory (same contract as the
+            # __nd__ decode path).
+            return np.frombuffer(
+                blobs[int(o["__blob__"])], dtype=np.dtype(o["dtype"])
+            ).reshape(o["shape"]).copy()
+        return {k: _restore_blobs(v, blobs) for k, v in o.items()}
+    if isinstance(o, list):
+        return [_restore_blobs(v, blobs) for v in o]
+    return o
+
+
+def loads_frame(data: bytes) -> Any:
+    """Decode either frame form (dispatch on the magic prefix)."""
+    if not data.startswith(RAW_MAGIC):
+        return loads(data)
+    if len(data) < len(RAW_MAGIC) + _LEN.size:
+        raise RpcError("raw frame truncated before its header length")
+    (hlen,) = _LEN.unpack_from(data, len(RAW_MAGIC))
+    off = len(RAW_MAGIC) + _LEN.size
+    if off + hlen > len(data):
+        raise RpcError(
+            f"raw frame header of {hlen} bytes overruns the "
+            f"{len(data)}-byte frame")
+    header = json.loads(data[off:off + hlen].decode(),
+                        object_hook=_dec_hook)
+    off += hlen
+    blobs = []
+    for n in header["b"]:
+        if off + n > len(data):
+            raise RpcError("raw frame blob table overruns the frame")
+        blobs.append(data[off:off + n])
+        off += n
+    if off != len(data):
+        raise RpcError(f"raw frame has {len(data) - off} trailing bytes")
+    return _restore_blobs(header["h"], blobs)
 
 
 # -- framing ---------------------------------------------------------------
@@ -182,7 +285,7 @@ def call(addr: Tuple[str, int], op: str, payload: Optional[dict] = None,
     transport exhaustion, ``RpcRemoteError`` on a handler exception
     (never retried — the op reached the worker)."""
     t_deadline = time.monotonic() + float(deadline_s)
-    request = dumps({"op": op, "payload": payload or {}})
+    request = dumps_frame({"op": op, "payload": payload or {}})
     attempt = 0
     last: Optional[BaseException] = None
     # Host-timing jitter only (never touches decoded chains): an
@@ -208,7 +311,7 @@ def call(addr: Tuple[str, int], op: str, payload: Optional[dict] = None,
                 s.settimeout(max(t_deadline - time.monotonic(), 0.001))
                 sent = True
                 send_msg(s, request)
-                resp = loads(recv_msg(s))
+                resp = loads_frame(recv_msg(s))
             if "error" in resp:
                 err = resp["error"]
                 raise RpcRemoteError(err.get("type", "RuntimeError"),
@@ -293,7 +396,7 @@ class RpcServer:
         with conn:
             try:
                 conn.settimeout(self._read_timeout_s)
-                msg = loads(recv_msg(conn))
+                msg = loads_frame(recv_msg(conn))
             except (OSError, RpcError, ValueError):
                 return  # half-open/garbage connection: drop it
             try:
@@ -304,6 +407,6 @@ class RpcServer:
                 resp = {"error": {"type": type(e).__name__,
                                   "msg": str(e)}}
             try:
-                send_msg(conn, dumps(resp))
+                send_msg(conn, dumps_frame(resp))
             except (OSError, RpcError, TypeError):
                 pass  # client went away / unencodable: nothing to do

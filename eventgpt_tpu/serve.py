@@ -1517,6 +1517,11 @@ class _Request:
     t_submit: float = 0.0
     t_first: Optional[float] = None
     t_done: Optional[float] = None
+    # Imported handoffs rebase t_submit into the past by the prefill
+    # leg's shipped duration so stats/SLO score the whole life; the
+    # journey leg must stay LOCAL (the coordinator stitches legs from
+    # durations) — this holds the local begin stamp for it.
+    t_journey: Optional[float] = None
     # Last harvest that committed tokens for this row (inter-token-latency
     # telemetry: gaps between consecutive harvests, weighted by tokens).
     t_last: Optional[float] = None
@@ -1553,6 +1558,11 @@ class _Request:
     # counts evictions (observability; bench records it per request).
     spill_run: Optional[int] = None
     preempts: int = 0
+    # Prefill/decode disaggregation (ISSUE 17): on a decode-role worker,
+    # the gathered block-run record this request arrived with (the
+    # spill-record shape, shipped over RPC). ``_admit`` splices it into
+    # the local arena instead of re-prefilling; cleared once spliced.
+    handoff_rec: Optional[Dict[str, Any]] = None
 
 
 class ContinuousBatcher:
@@ -1630,6 +1640,7 @@ class ContinuousBatcher:
         spec_hysteresis: float = 0.05,
         spec_row_window: int = 4,
         spec_head_min_yield: float = 0.05,
+        role: str = "colocated",
     ):
         if prefill_chunk and (2 * SEQ_BUCKET) % prefill_chunk:
             # A chunk that does not divide the bucket grain would force
@@ -1696,6 +1707,29 @@ class ContinuousBatcher:
         if kv_layout not in ("dense", "paged"):
             raise ValueError(
                 f"kv_layout must be 'dense' or 'paged', got {kv_layout!r}")
+        # Disaggregated serving role (ISSUE 17): "colocated" (default)
+        # admits AND decodes — the single-engine behavior, unchanged.
+        # "prefill" runs chunked/batched admission only: each activated
+        # row's block run is gathered and parked in ``handoff_ready``
+        # for the fleet coordinator to ship (``_handoff_sweep``).
+        # "decode" additionally accepts gathered records through
+        # ``import_handoff`` and splices them into its own arena. The
+        # handoff record is block-shaped (the PR 16 spill record), so
+        # split roles require the paged layout.
+        if role not in ("colocated", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'colocated', 'prefill' or 'decode', "
+                f"got {role!r}")
+        if role != "colocated" and kv_layout != "paged":
+            raise ValueError(
+                f"role={role!r} requires kv_layout='paged' (the handoff "
+                f"moves block runs)")
+        self.role = role
+        if role == "prefill":
+            # Piggyback lanes advance inside the decode dispatch, which
+            # a prefill-role scheduler never runs — lanes would starve.
+            # Chunked/wave admission covers the prefill worker's job.
+            prefill_budget = 0
         self.kv_layout = kv_layout
         self._paged = kv_layout == "paged"
         self._pool: Optional[serve_blocks.BlockPool] = None
@@ -1815,6 +1849,15 @@ class ContinuousBatcher:
         self.n_rem = np.zeros((max_batch,), np.int64)
         self.rows: List[Optional[_Request]] = [None] * max_batch
         self.queue: deque[_Request] = deque()
+        # Prefill->decode handoff outbox (ISSUE 17): records the
+        # prefill role's sweep gathered, awaiting coordinator
+        # collection (``pop_handoffs``); the counters feed the /fleet
+        # role block and the /stats fleet-wide aggregation.
+        self.handoff_ready: List[Dict[str, Any]] = []
+        self.handoffs_gathered = 0
+        self.handoffs_gathered_bytes = 0
+        self.handoffs_spliced = 0
+        self.handoffs_spliced_bytes = 0
         self.finished: Dict[int, List[int]] = {}
         # Terminal status per finished rid (STATUS_*): drained by the
         # serving engine at harvest; bounded for direct batcher users the
@@ -3217,6 +3260,15 @@ class ContinuousBatcher:
             tr = obs_trace.active()
             if tr is not None:
                 tr.complete("admit", t0, t0 + dt_admit, cat="sched")
+        if self.role == "prefill":
+            # Prefill role: admission IS the job. Activated rows never
+            # decode here — the sweep gathers each one's block run into
+            # the handoff outbox for the coordinator to ship to a decode
+            # worker; chunked admissions keep advancing through _admit
+            # above. Nothing dispatches, so there is never an in-flight
+            # segment to drain.
+            self._handoff_sweep()
+            return
         if all(r is None for r in self.rows):
             self._drain()  # trailing all-frozen segment, if any
             return
@@ -3926,7 +3978,8 @@ class ContinuousBatcher:
                               t=req.t_done)
         jrec = obs_journey.finish(
             self._journey_owner, req.rid, status,
-            t_submit=req.t_submit, t_done=req.t_done,
+            t_submit=(req.t_journey if req.t_journey is not None
+                      else req.t_submit), t_done=req.t_done,
             slo_class=(req.slo.name if req.slo is not None else None),
             slo_met=slo_met)
         if jrec is not None and req.slo is not None and not slo_met:
@@ -4482,16 +4535,24 @@ class ContinuousBatcher:
         return True
 
     # egpt-check: harvest -- spill gathers the victim's KV run + row state to host RAM; the preemption boundary is a drained admission decision, outside the pipelined dispatch overlap
-    def _gather_spill_record(self, vic) -> Dict[str, Any]:
+    def _gather_spill_record(self, vic,
+                             blocks: Optional[List[int]] = None
+                             ) -> Dict[str, Any]:
         """The victim's complete re-activation state, gathered dense to
         host RAM: its block run's KV (the same ``_gather_blocks`` copy
         ``export_requests``' drain seam and the prefix entries use),
         cache length, logits row, and the speculative row state
         (ids_buf / base_pos / medusa drafts). Whole-block copies are
         byte-exact — attention masks positions past ``length``, so the
-        restore scatter reproduces the row bit-for-bit."""
+        restore scatter reproduces the row bit-for-bit.
+
+        ``blocks`` overrides the gathered run (the prefill->decode
+        handoff gathers the aliased+owned table run, trimmed to the
+        blocks covering ``length``); default is the spill path's
+        exclusively-owned run."""
         row = vic.row
-        blocks = jnp.asarray(vic.kv_blocks_owned, jnp.int32)
+        block_ids = vic.kv_blocks_owned if blocks is None else blocks
+        blocks = jnp.asarray(block_ids, jnp.int32)
         if self.mesh is not None:
             blocks = self._serving.replicate(blocks, self.mesh)
             fn = _get_sharded_gather_blocks(
@@ -4517,7 +4578,7 @@ class ContinuousBatcher:
         # Bandwidth EWMA feeding _spill_choose (measured, not assumed).
         self._spill_bw_Bps = (0.7 * self._spill_bw_Bps
                               + 0.3 * nbytes / max(elapsed, 1e-6))
-        host["n_blocks"] = len(vic.kv_blocks_owned)
+        host["n_blocks"] = len(block_ids)
         host["nbytes_kv"] = nbytes
         host["base_pos"] = (int(self.base_pos[row])
                             if self.speculative else 0)
@@ -4584,6 +4645,246 @@ class ContinuousBatcher:
             sum(r is not None for r in self.rows))
         obs_journey.event(self._journey_owner, req.rid, "restore",
                           row=row, blocks=rec["n_blocks"])
+        return True
+
+    # -- prefill/decode disaggregation: paged-KV handoff (ISSUE 17) --------
+
+    def _handoff_sweep(self) -> None:
+        """Prefill role only (``step`` calls this instead of
+        dispatching): every ACTIVATED row leaves the scheduler through
+        the handoff outbox — its block run gathered to host RAM, its
+        reservation released — so the next admission wave always finds
+        free rows and free blocks. Reserved rows (a pending chunked
+        admission, a piggyback lane) stay: they are mid-admission and
+        sweep on a later step, once activated."""
+        for row, req in enumerate(self.rows):
+            if req is None or self.frozen[row]:
+                continue
+            if self.n_rem[row] <= 0:
+                # The budget was met inside the admission dispatch (a
+                # 1-token speculative budget commits t0 at activation):
+                # nothing is left to decode, so nothing moves — finish
+                # here like a colocated harvest would.
+                self._finish_row(row)
+                continue
+            self._handoff_gather(req)
+
+    def _handoff_gather(self, req) -> None:
+        """Gather one activated row into a handoff record and tear the
+        row down (the per-request half of ``export_requests``' drain
+        seam). The record is the spill record plus routing state: the
+        shipped KV covers only the blocks up to ``length`` (attention
+        masks everything past it and decode overwrites positions before
+        reading them — the spill byte-identity argument), while
+        ``n_total`` names the full reservation the decode worker must
+        re-allocate. Prefix-aliased blocks ship as part of the run —
+        sharing does not cross the wire; the decode side owns a private
+        copy."""
+        row = req.row
+        length = req.prompt_len + len(req.tokens)
+        run = req.kv_blocks_aliased + req.kv_blocks_owned
+        n_ship = min(max(self._pool.blocks_for(length), 1), len(run))
+        rec = self._gather_spill_record(req, blocks=run[:n_ship])
+        rec["n_total"] = len(run)
+        self._paged_release(req)
+        if req.prefix_entry is not None:
+            self._drain_entry_pin(req.prefix_entry)
+            req.prefix_entry = None
+        self.rows[row] = None
+        req.row = -1
+        self.frozen[row] = True
+        self.n_rem[row] = 0
+        if self.speculative:
+            self.base_pos[row] = 0
+        if self._spec_ctl is not None:
+            self._spec_ctl.forget(req.rid)
+        if req.deadline is not None:
+            self._n_deadlines -= 1
+        self._dev_carry = None
+        now = time.perf_counter()
+        obs_trace.async_end(req.phase, req.rid, status="handoff")
+        self.handoffs_gathered += 1
+        self.handoffs_gathered_bytes += rec["nbytes_kv"]
+        obs_metrics.PROCFLEET_HANDOFFS.inc(stage="gathered")
+        obs_metrics.SERVE_ACTIVE_ROWS.set(
+            sum(r is not None for r in self.rows))
+        obs_journey.event(self._journey_owner, req.rid, "kv_handoff",
+                          stage="gathered", bytes=rec["nbytes_kv"],
+                          blocks=rec["n_blocks"])
+        # The request is not over, it is MOVING (the export_requests
+        # rule): "handoff" is a journey-only terminal — finish_status is
+        # never written — and the closed prefill-leg journey rides the
+        # outbox record so the coordinator can stitch both legs plus
+        # the wire time into one exact-sum timeline.
+        obs_journey.finish(
+            self._journey_owner, req.rid, "handoff",
+            t_submit=req.t_submit, t_done=now,
+            slo_class=(req.slo.name if req.slo is not None else None))
+        self.handoff_ready.append({
+            "rid": req.rid,
+            "input_ids": list(req.input_ids),
+            "tokens": list(req.tokens),
+            "max_new_tokens": req.max_new_tokens,
+            "prompt_len": req.prompt_len,
+            # Durations, not timestamps (clocks don't cross processes):
+            # the decode worker rebases its local clock by elapsed_s so
+            # TTFT / latency / SLO attainment score the request's WHOLE
+            # life, not just the decode leg. t_gather stays worker-local
+            # (the handler refreshes elapsed_s with the outbox wait at
+            # each collect and strips it from the wire record).
+            "t_gather": now,
+            "elapsed_s": now - req.t_submit,
+            "ttft_s": (req.t_first - req.t_submit
+                       if req.t_first is not None else None),
+            "deadline_s": (req.deadline - now
+                           if req.deadline is not None else None),
+            "slo": req.slo,
+            "preempts": req.preempts,
+            "journey": obs_journey.get(self._journey_owner, req.rid),
+            "rec": rec,
+        })
+
+    def pop_handoffs(self) -> List[Dict[str, Any]]:
+        """Drain the handoff outbox (the coordinator's collection hook).
+        Delivery past this point is the caller's problem — the worker
+        handler keeps popped records replayable until the coordinator
+        acks them, so a collect lost to a transport fault re-serves."""
+        out, self.handoff_ready = self.handoff_ready, []
+        return out
+
+    def import_handoff(self, input_ids: Sequence[int],
+                       max_new_tokens: int, rec: Dict[str, Any],
+                       tokens: Sequence[int] = (), prompt_len: int = 0,
+                       deadline_s: Optional[float] = None,
+                       slo: Optional[SLO] = None,
+                       elapsed_s: float = 0.0,
+                       ttft_s: Optional[float] = None) -> int:
+        """Decode role: accept a prefill worker's gathered block-run
+        record. The request enqueues like a submit but SPLICES at
+        admission (``_handoff_splice``) instead of prefilling, and it
+        bypasses ``max_queue`` — it was already admitted into the system
+        at the prefill worker's queue, and bouncing it here would strand
+        KV that no longer exists anywhere else. ``pixel_values`` are
+        deliberately absent: the splice never re-prefills, and the REDO
+        path re-routes from the coordinator's own submission record."""
+        if self.role == "prefill":
+            raise ValueError(
+                "a prefill-role scheduler cannot import handoffs")
+        if not self._paged:
+            raise ValueError("import_handoff requires kv_layout='paged'")
+        if slo is not None and slo.name not in SLO_CLASSES:
+            raise ValueError(
+                f"unknown SLO class {slo.name!r}: one of {SLO_CLASSES}")
+        need = self._blocks_needed(int(prompt_len), max_new_tokens)
+        if need > self._pool.usable:
+            raise ValueError(
+                f"handoff does not fit: needs {need} KV blocks, the "
+                f"pool holds {self._pool.usable} (raise "
+                f"--kv_pool_blocks)")
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _Request(rid, list(input_ids), None, max_new_tokens)
+        req.tokens = list(tokens)
+        req.prompt_len = int(prompt_len)
+        req.slo = slo
+        now = time.perf_counter()
+        # Rebase the request's clock by the prefill leg + wire time
+        # (shipped as a DURATION — absolute stamps never cross
+        # processes): t_submit lands in the past and t_first at the
+        # prefill worker's commit offset, so every downstream stat —
+        # ttft_s, itl_s (the handoff gap is one inter-token interval),
+        # latency_s, slo.met — scores the request's whole life exactly
+        # like a colocated run, with no special-casing in _finish_row.
+        # The deadline anchors at NOW: deadline_s is the REMAINING
+        # headroom, already net of the elapsed time.
+        req.t_submit = now - max(float(elapsed_s or 0.0), 0.0)
+        req.t_journey = now
+        if ttft_s is not None:
+            req.t_first = req.t_submit + float(ttft_s)
+        if deadline_s is not None:
+            req.deadline = now + float(deadline_s)
+            self._n_deadlines += 1
+        req.handoff_rec = rec
+        self.queue.append(req)
+        obs_metrics.SERVE_QUEUE_DEPTH.set(len(self.queue))
+        obs_trace.async_begin("queued", rid, prompt_len=req.prompt_len,
+                              budget=max_new_tokens)
+        # No obs_series.note_submit(): the arrival was already counted
+        # at the prefill worker — an import is a continuation, and
+        # double-counting would skew the fleet-wide arrival series.
+        # The journey leg stays LOCAL (t=now, not the rebased stamp):
+        # the coordinator stitches prefill phases + handoff_s + this
+        # leg from durations, so a rebased begin would double-count.
+        obs_journey.begin(
+            self._journey_owner, rid, t=now,
+            prompt_len=req.prompt_len, budget=max_new_tokens,
+            **({"slo_class": slo.name} if slo is not None else {}))
+        return rid
+
+    def _handoff_splice(self, req, row: int) -> bool:
+        """Splice an imported handoff record into the local arena: a
+        fresh fully-owned allocation for the FULL reservation
+        (``n_total`` — the same blocks-for-cover arithmetic both roles
+        compute from identical flags), then the SAME ``_admit_row_paged``
+        scatter every paged admission rides, over the shipped prefix of
+        the run. False = the pool cannot cover the reservation right
+        now (only an allocation race against the gate's pre-check — the
+        caller re-queues, the record stays put)."""
+        rec = req.handoff_rec
+        total = int(rec.get("n_total", rec["n_blocks"]))
+        blocks = self._pool.alloc(total)
+        if blocks is None:
+            return False
+        req.handoff_rec = None
+        req.kv_blocks_owned = blocks
+        req.kv_blocks_aliased = []
+        n_ship = int(rec["n_blocks"])
+        dst = jnp.asarray(blocks[:n_ship], jnp.int32)
+        btr = jnp.asarray(self._paged_bt_row(req))
+        row_cache = {"k": rec["k"], "v": rec["v"],
+                     "length": np.asarray([rec["length"]], np.int32)}
+        row_logits = np.asarray(rec["logits"])[None]  # egpt-check: ignore[hot-sync] -- rec came off the RPC wire: every plane is already host-resident numpy (the raw-frame decoder builds them), so this asarray is a view, never a device fetch
+        if self.mesh is not None:
+            dst = self._serving.replicate(dst, self.mesh)
+            btr = self._serving.replicate(btr, self.mesh)
+            admit = _get_sharded_admit_paged(
+                self._cache_flat_sh, self._cache_treedef,
+                self._logits_sh)
+        else:
+            admit = _admit_row_paged_jit
+        self.cache, self.logits = admit(
+            self.cache, self.logits, row, dst, btr, row_cache, row_logits
+        )
+        req.kv_bt_written = True
+        self.rows[row] = req
+        req.row = row
+        self.frozen[row] = False
+        self.n_rem[row] = req.max_new_tokens - len(req.tokens)
+        if self.speculative:
+            self.ids_buf = self.ids_buf.at[row].set(
+                jnp.asarray(rec["ids"]))
+            if self.mesh is not None:
+                self.ids_buf = jax.device_put(self.ids_buf, self._ids_sh)
+            self.base_pos[row] = rec["base_pos"]
+        if "drafts" in rec:
+            self.spec_drafts = self.spec_drafts.at[row].set(
+                jnp.asarray(rec["drafts"]))
+            if self.mesh is not None:
+                self.spec_drafts = jax.device_put(
+                    self.spec_drafts, self._drafts_sh)
+        self._dev_carry = None
+        obs_trace.async_end("queued", req.rid)
+        obs_trace.async_begin("active", req.rid)
+        req.phase = "active"
+        nbytes = int(rec.get("nbytes_kv", 0))
+        self.handoffs_spliced += 1
+        self.handoffs_spliced_bytes += nbytes
+        obs_metrics.PROCFLEET_HANDOFFS.inc(stage="spliced")
+        obs_metrics.SERVE_ACTIVE_ROWS.set(
+            sum(r is not None for r in self.rows))
+        obs_journey.event(self._journey_owner, req.rid, "kv_handoff",
+                          stage="spliced", row=row, blocks=n_ship,
+                          bytes=nbytes)
         return True
 
     def _drain_entry_pin(self, entry: _PrefixEntry) -> None:
@@ -4697,6 +4998,17 @@ class ContinuousBatcher:
                 # (ISSUE 16). The gate pre-checked the same reservation
                 # arithmetic, so failure here is only an eviction race.
                 if self._paged_restore(req, row):
+                    continue
+                self._paged_requeue(req, row)
+                break
+            if self._paged and req.handoff_rec is not None:
+                # A prefill worker's handoff splices through the same
+                # paged admission seam (ISSUE 17): fresh blocks for the
+                # full reservation, the shipped run scattered byte-exact
+                # — never a re-prefill. The gate pre-checked the same
+                # reservation arithmetic, so failure is only an
+                # allocation race.
+                if self._handoff_splice(req, row):
                     continue
                 self._paged_requeue(req, row)
                 break

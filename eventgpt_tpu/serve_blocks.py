@@ -135,6 +135,15 @@ class BlockPool:
         with self._lock:
             return self.usable - len(self._free)
 
+    def free_bytes(self) -> int:
+        """Free capacity in bytes (``free_blocks * block_bytes``; 0 when
+        the pool was built without a byte size) — the decode-placement
+        headroom signal the disaggregated router balances on (ISSUE 17):
+        block counts only compare within one worker's geometry, bytes
+        compare across a fleet."""
+        with self._lock:
+            return len(self._free) * self.block_bytes
+
     def blocks_for(self, positions: int) -> int:
         """Blocks covering ``positions`` KV slots (ceil at the block
         grain) — the reservation arithmetic shared by admission gating,

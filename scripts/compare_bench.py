@@ -165,9 +165,15 @@ def _trace_identity(rec: Dict[str, Any]) -> Optional[Tuple]:
     # gather is a real per-token cost, so dense-vs-paged tok_s measures
     # the layout, not drift — those records drop tok_s with an unpaired
     # note. Records predating the key are dense by construction.
+    # The role split joins it last (ISSUE 17): a disaggregated fleet
+    # runs admission and decode on DIFFERENT processes, so colocated-
+    # vs-disagg tok_s measures the topology, not drift — the honest
+    # cross-arm comparison is the SLO tails, which pair fine. Records
+    # predating the key are colocated by construction.
     return (r.get("requests"), r.get("seed"), r.get("arrival"),
             r.get("sessions"), r["output_min"], r["output_max"],
-            r.get("proc_fleet"), r.get("kv_layout") or "dense")
+            r.get("proc_fleet"), r.get("kv_layout") or "dense",
+            r.get("proc_fleet_roles") or "colocated")
 
 
 def compare(base: Dict[str, Any], new: Dict[str, Any],
@@ -204,10 +210,14 @@ def compare(base: Dict[str, Any], new: Dict[str, Any],
     # bytes live in kv_pool/kv_block_table where a dense point's live in
     # kv_cache — cross-layout memory deltas are the layout change
     # itself, not drift.
+    # proc_fleet_roles joins the topology too (ISSUE 17): a prefill
+    # worker's resident bytes have no decode arena and vice versa.
     bt = (_unwrap(base).get("fleet"), _unwrap(base).get("proc_fleet"),
-          _unwrap(base).get("kv_layout") or "dense")
+          _unwrap(base).get("kv_layout") or "dense",
+          _unwrap(base).get("proc_fleet_roles") or "colocated")
     nt = (_unwrap(new).get("fleet"), _unwrap(new).get("proc_fleet"),
-          _unwrap(new).get("kv_layout") or "dense")
+          _unwrap(new).get("kv_layout") or "dense",
+          _unwrap(new).get("proc_fleet_roles") or "colocated")
     if bt != nt:
         dropped = sorted(k for k in set(b) | set(n)
                          if "mem_peak" in k or ".memory." in k

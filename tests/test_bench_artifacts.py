@@ -596,3 +596,119 @@ def test_compare_bench_gates_oom_columns():
             del leg["chains_identical"]
     regs, _ = mod.compare(rec, gone, require=("chains_identical",))
     assert any("not comparable" in r for r in regs)
+
+
+def test_disagg_workload_artifact_schema_and_acceptance():
+    """ISSUE 17 acceptance: the checked-in disaggregation A/B
+    (``WORKLOAD_DISAGG_r0N.json``) — one trace, four process
+    topologies (colocated 2- and 4-worker, 1P:1D, 1P:3D) on the
+    paged layout. Chains byte-identical across every arm and point
+    (disaggregation is placement, never numerics); every disagg
+    request actually crossed the handoff seam (the counters are
+    earned, not vacuous) while the colocated arm shipped nothing; the
+    journey decomposition carries the ``handoff_s`` phase everywhere;
+    and at the saturation point BOTH disagg arms hold the tentpole
+    claim — interactive TTFT p99 (admission never waits behind
+    decode-occupied rows) AND ITL p99 (decode never stalls behind a
+    neighbour's prefill chunks) at-or-under the colocated fleet's."""
+    paths = sorted(glob.glob(os.path.join(ROOT, "WORKLOAD_DISAGG_r0*.json")))
+    assert paths, "no WORKLOAD_DISAGG_r0*.json checked in"
+    rec = _load(paths[-1])
+    assert rec["metric"].startswith("workload_disagg_")
+    assert rec["chains_identical"] is True
+    arms = rec["arms"]
+    expect = {"colocated2": ("colocated", 2),
+              "colocated4": ("colocated", 4),
+              "disagg_1p1d": ("1:1", 2),
+              "disagg_1p3d": ("1:3", 4)}
+    assert set(arms) == set(expect)
+    for name, arm in arms.items():
+        roles, n_proc = expect[name]
+        assert arm["proc_fleet_roles"] == roles, name
+        assert arm["proc_fleet"] == n_proc, name
+        assert arm["kv_layout"] == "paged", name
+        for k in ("output_min", "output_max", "trace_output_tokens"):
+            assert isinstance(arm.get(k), int), (name, k)
+        sweep = arm["sweep"]
+        assert len(sweep) >= 2, f"{name}: need >= 2 offered-load points"
+        for leg in sweep:
+            assert "handoff" in leg["miss_causes"], name
+            ho = leg["handoffs"]
+            if name.startswith("colocated"):
+                assert ho["shipped"] == 0 and ho["bytes"] == 0, (name, ho)
+            else:
+                # Every request is admitted on a prefill-role worker
+                # and decoded elsewhere: all of them crossed the seam.
+                assert ho["shipped"] >= arm["requests"], (name, ho)
+                assert ho["bytes"] > 0, (name, ho)
+            assert len(leg["classes"]) >= 2, name
+            for cname, c in leg["classes"].items():
+                assert "handoff_p99_s" in c, (name, cname)
+                assert "handoff_s" in c["attribution"], (name, cname)
+    comp = rec["comparison"]
+    assert comp["saturation_rate_mult"] == max(
+        leg["rate_mult"] for leg in arms["colocated2"]["sweep"])
+    # Each disagg arm beats the colocated fleet with the SAME process
+    # count (on a shared-CPU host the process count is part of the
+    # topology; 1P:1D vs colocated-2 is the headline pair).
+    assert comp["disagg_1p1d"]["baseline"] == "colocated2"
+    assert comp["disagg_1p3d"]["baseline"] == "colocated4"
+    for name in ("disagg_1p1d", "disagg_1p3d"):
+        assert comp[name]["ttft_p99_beats_colocated"] is True, comp
+        assert comp[name]["itl_p99_beats_colocated"] is True, comp
+
+
+def test_compare_bench_gates_disagg_artifact():
+    """ISSUE 17 satellite: the disagg artifact is tier-1-gateable with
+    ``--require`` pinned to the tentpole's own keys — the SLO tails
+    and goodput. Self-comparison gates clean (the wrapper's arms
+    flatten and pair; no required key goes missing), and a degraded
+    disagg TTFT tail fires: the gate has teeth exactly where the
+    acceptance claim lives."""
+    mod = _compare_mod()
+    paths = sorted(glob.glob(os.path.join(ROOT, "WORKLOAD_DISAGG_r0*.json")))
+    rec = _load(paths[-1])
+    require = ("ttft_p99_s", "itl_p99_s", "goodput_rps")
+    regs, _ = mod.compare(rec, rec, require=require)
+    assert regs == [], f"disagg artifact must self-compare clean: {regs}"
+    worse = json.loads(json.dumps(rec))
+    for leg in worse["arms"]["disagg_1p1d"]["sweep"]:
+        for c in leg["classes"].values():
+            c["ttft_p99_s"] *= 3.0
+    regs, _ = mod.compare(rec, worse, require=require)
+    assert any("ttft_p99_s" in r for r in regs), regs
+
+
+def test_compare_bench_disagg_roles_join_both_identities():
+    """The ISSUE 17 pairing rule: ``proc_fleet_roles`` joins the
+    trace-identity tuple AND the memory-topology tuple. The colocated
+    arm vs the SAME-trace 1P:1D arm (equal process count!) drops
+    tok_s with an ``unpaired`` note instead of gating architecture as
+    drift — even when the disagg tok_s is degraded enough that a
+    (wrong) pairing would fire — while each arm still self-compares
+    on tok_s; and a roles flip on an otherwise identical record drops
+    the per-worker memory keys too (a prefill worker's resident bytes
+    have no decode arena)."""
+    mod = _compare_mod()
+    rec = _load(sorted(glob.glob(
+        os.path.join(ROOT, "WORKLOAD_DISAGG_r0*.json")))[-1])
+    colo = rec["arms"]["colocated2"]
+    disagg = rec["arms"]["disagg_1p1d"]
+    regs, _ = mod.compare(colo, colo, require=("tok_s",))
+    assert regs == [], f"tok_s must be self-comparable: {regs}"
+    other = json.loads(json.dumps(disagg))
+    for leg in other["sweep"]:
+        leg["tok_s"] *= 0.3  # would fire if (wrongly) paired
+    regs, notes = mod.compare(colo, other)
+    assert not any("tok_s" in r for r in regs), regs
+    assert any("unpaired" in n and "tok_s" in n for n in notes), notes
+    # Memory half, exercised on the fleet workload record (it carries
+    # mem_peak/memory.* keys — the procfleet record records none):
+    # flipping ONLY the roles key unpairs both tuples.
+    pf = _load(sorted(glob.glob(
+        os.path.join(ROOT, "WORKLOAD_FLEET_r0*.json")))[0])
+    roled = json.loads(json.dumps(pf))
+    roled["proc_fleet_roles"] = "1:1"
+    regs, notes = mod.compare(pf, roled)
+    assert any("unpaired" in n and "memory" in n for n in notes), notes
+    assert any("unpaired" in n and "tok_s" in n for n in notes), notes

@@ -6,6 +6,7 @@ policy is exercised in real OS processes without paying a jax import
 per worker. The real-engine chain-identity and SIGKILL chaos tests
 live in tests/test_fleet_proc_chaos.py."""
 
+import threading
 import time
 
 import numpy as np
@@ -252,3 +253,374 @@ def test_series_and_alerts_aggregate_over_stub_workers():
     finally:
         fleet.shutdown()
         obs_series.disable()
+
+
+# -- prefill/decode disaggregation (ISSUE 17) --------------------------------
+
+def _disagg_fleet(roles, n=None, **kw):
+    n = n if n is not None else sum(
+        int(x) for x in roles.split(":"))
+    return _fleet(n=n, roles=roles, **kw)
+
+
+def test_roles_spec_validation():
+    with pytest.raises(ValueError, match="want P:D"):
+        _fleet(n=2, roles="2")
+    with pytest.raises(ValueError, match="want P:D"):
+        _fleet(n=2, roles="a:b")
+    with pytest.raises(ValueError, match="at least one prefill"):
+        _fleet(n=2, roles="2:0")
+    with pytest.raises(ValueError, match="!= fleet size"):
+        _fleet(n=2, roles="2:2")
+
+
+def test_disagg_chain_identity_roles_and_journey_stitch():
+    """1P:1D over the stub: the submit routes to the prefill worker,
+    the gathered record ships across the raw RPC frame (the stub
+    REJECTS a corrupted KV plane, so transport is asserted bit-exact),
+    the decode worker finishes the SAME chain a colocated stub
+    produces, and the stitched journey carries all three legs with the
+    exact phase-sum invariant."""
+    fleet = _disagg_fleet("1:1")
+    try:
+        assert [s.role for s in fleet.slots] == ["prefill", "decode"]
+        ids = [1, 2, EVENT, 7]
+        fr = fleet.submit_ids(ids, _pv(1), 6)
+        assert fleet.result(fr, timeout=60) == _stub_chain(ids, 6)
+        # The request ENDED on the decode worker (slot 1).
+        assert fleet.worker_of(fr) == 1
+        j = fleet.journey(fr)
+        kinds = [e["kind"] for e in j["events"]]
+        assert "kv_handoff" in kinds
+        ev = next(e for e in j["events"] if e["kind"] == "kv_handoff")
+        assert ev["stage"] == "shipped"
+        assert ev["from_worker"] == 0 and ev["to_worker"] == 1
+        assert ev["bytes"] == 4 * len(ids)
+        assert j["phases"]["handoff_s"] > 0.0
+        assert sum(j["phases"].values()) == pytest.approx(
+            j["e2e_s"], abs=1e-6)
+
+        st = fleet.stats()
+        assert st["fleet"]["roles"] == "1:1"
+        h = st["fleet"]["handoffs"]
+        assert h["shipped"] == 1 and h["redos"] == 0
+        assert h["bytes"] == 4 * len(ids)
+        assert h["gathered"] >= 1 and h["spliced"] >= 1
+        per = st["fleet"]["per_worker"]
+        assert [w["role"] for w in per] == ["prefill", "decode"]
+        assert all(w["kv_free_blocks"] is not None for w in per)
+        assert fleet.fleet_stats()["policy"]["handoff_retries"] == 3
+    finally:
+        fleet.shutdown()
+
+
+def test_colocated_fleet_unchanged_by_roles_none():
+    """roles=None keeps every slot colocated: no handoff machinery
+    runs, and the stats shape is stable (None/0s, not missing keys)."""
+    fleet = _fleet()
+    try:
+        ids = [1, 2, EVENT, 4]
+        fr = fleet.submit_ids(ids, _pv(0), 5)
+        assert fleet.result(fr, timeout=60) == _stub_chain(ids, 5)
+        st = fleet.stats()
+        assert st["fleet"]["roles"] is None
+        assert st["fleet"]["handoffs"]["shipped"] == 0
+        kinds = [e["kind"] for e in fleet.journey(fr)["events"]]
+        assert "kv_handoff" not in kinds
+    finally:
+        fleet.shutdown()
+
+
+def test_decode_placement_balances_pool_headroom():
+    """1P:2D: the stub's snapshot headroom shrinks with resident
+    requests, so a second in-flight handoff must land on the OTHER
+    decode worker once the probe sees the first one busy."""
+    fleet = _disagg_fleet("1:2", token_delay_s=0.05)
+    try:
+        ids = [1, 2, EVENT, 9]
+        fr1 = fleet.submit_ids(ids, _pv(1), 30)
+        # Wait until the first ship lands and a probe refreshed the
+        # decode snapshots (its worker now reports less free pool).
+        deadline = time.time() + 30
+        while time.time() < deadline and fleet.n_handoffs < 1:
+            time.sleep(0.01)
+        assert fleet.n_handoffs == 1
+        w1 = fleet.worker_of(fr1)
+        assert fleet.slots[w1].role == "decode"
+        deadline = time.time() + 30
+        while time.time() < deadline and not (
+                (fleet.slots[w1].snapshot or {}).get(
+                    "kv_free_blocks", 256) < 256):
+            time.sleep(0.01)
+        fr2 = fleet.submit_ids([1, 2, EVENT, 8], _pv(2), 30)
+        deadline = time.time() + 30
+        while time.time() < deadline and fleet.n_handoffs < 2:
+            time.sleep(0.01)
+        assert fleet.n_handoffs == 2
+        w2 = fleet.worker_of(fr2)
+        assert fleet.slots[w2].role == "decode"
+        assert w2 != w1, "both handoffs piled onto one decode worker"
+        for fr, budget in ((fr1, 30), (fr2, 30)):
+            got = fleet.result(fr, timeout=60)
+        assert fleet.result(fr1, timeout=60) == _stub_chain(ids, 30)
+    finally:
+        fleet.shutdown()
+
+
+def test_breaker_opens_when_one_side_is_gone():
+    """A disaggregated fleet needs BOTH a routable prefill and a
+    routable decode worker: losing the whole decode side opens the
+    breaker even though prefill workers still answer."""
+    fleet = _disagg_fleet("1:1", respawn_backoff_s=5.0)
+    try:
+        assert not fleet.breaker_open()
+        fleet.kill_worker(1)  # the decode side
+        deadline = time.time() + 30
+        while time.time() < deadline and not fleet.breaker_open():
+            time.sleep(0.01)
+        assert fleet.breaker_open()
+    finally:
+        fleet.shutdown()
+
+
+def test_chaos_handoff_trip_retries_to_other_decode_worker():
+    """``procfleet.handoff:n=1`` (rule 4: the site is armed) trips the
+    FIRST ship attempt; the bounded retry re-routes the same record to
+    the other decode worker — no REDO, chain identical, one retry
+    booked."""
+    faults.configure("procfleet.handoff:n=1")
+    fleet = _disagg_fleet("1:2")
+    try:
+        ids = [1, 2, EVENT, 6]
+        fr = fleet.submit_ids(ids, _pv(3), 8)
+        assert fleet.result(fr, timeout=60) == _stub_chain(ids, 8)
+        assert faults.stats()["procfleet.handoff"]["fires"] == 1
+        assert fleet.n_handoff_retries == 1
+        assert fleet.n_handoff_redos == 0
+        assert fleet.n_handoffs == 1
+        assert fleet.slots[fleet.worker_of(fr)].role == "decode"
+        j = fleet.journey(fr)
+        assert "failover" not in [e["kind"] for e in j["events"]]
+    finally:
+        fleet.shutdown()
+
+
+def test_chaos_handoff_exhaustion_falls_back_to_redo():
+    """With a single decode worker the tripped attempt has nowhere to
+    retry: the ship falls back to the REDO path (fresh prefill ->
+    handoff chain; the decode side never spliced, so nothing can
+    double-deliver) and the chain is still byte-identical."""
+    faults.configure("procfleet.handoff:n=1")
+    fleet = _disagg_fleet("1:1")
+    try:
+        ids = [1, 2, EVENT, 5]
+        fr = fleet.submit_ids(ids, _pv(4), 8)
+        assert fleet.result(fr, timeout=60) == _stub_chain(ids, 8)
+        assert faults.stats()["procfleet.handoff"]["fires"] == 1
+        assert fleet.n_handoff_redos == 1
+        # The redo chain re-prefilled and shipped cleanly: exactly one
+        # successful ship end to end, via one failover.
+        assert fleet.n_handoffs == 1
+        j = fleet.journey(fr)
+        ev = next(e for e in j["events"] if e["kind"] == "failover")
+        assert ev["path"] == "redo"
+        assert j["phases"]["failover_redo_s"] > 0.0
+        assert sum(j["phases"].values()) == pytest.approx(
+            j["e2e_s"], abs=1e-6)
+    finally:
+        fleet.shutdown()
+
+
+def test_drain_prefill_worker_flushes_and_reroutes():
+    """Draining the prefill worker mid-traffic flushes its outbox
+    (gathered records ship instead of dying with the process) and
+    re-routes anything still queued; every chain survives identical
+    and the slot respawns."""
+    fleet = _disagg_fleet("2:1", token_delay_s=0.03,
+                          respawn_backoff_s=0.05)
+    try:
+        ids = [1, 2, EVENT, 3]
+        frs = [fleet.submit_ids(ids, _pv(i), 20) for i in range(4)]
+        time.sleep(0.04)  # some gathered, some mid-prefill
+        pre = [s for s in fleet.slots if s.role == "prefill"]
+        busy = max(pre, key=lambda s: s.inflight)
+        fleet.drain_worker(busy.idx)
+        for fr in frs:
+            assert fleet.result(fr, timeout=60) == _stub_chain(ids, 20)
+        deadline = time.time() + 60
+        while time.time() < deadline and not all(
+                s.state == "ok" for s in fleet.slots):
+            time.sleep(0.02)
+        assert all(s.state == "ok" for s in fleet.slots)
+    finally:
+        fleet.shutdown()
+
+
+def test_disagg_worker_kill_legs_redo_to_surviving_chain():
+    """Role-aware kill legs at stub speed: SIGKILL a PREFILL worker
+    with requests in flight (its victims redo onto the surviving
+    prefill worker), then SIGKILL a DECODE worker holding spliced KV
+    (the REDO pool is the prefill side — the spliced KV died with the
+    process, so the only path is a fresh prefill -> handoff chain).
+    Every chain stays byte-identical."""
+    fleet = _disagg_fleet("2:2", token_delay_s=0.05,
+                          respawn_backoff_s=0.05)
+    try:
+        ids = [1, 2, EVENT, 11]
+        # Leg 1: kill a prefill worker mid-flight.
+        frs = [fleet.submit_ids(ids, _pv(i), 25) for i in range(4)]
+        time.sleep(0.03)  # land in the prefill stage
+        pre = [s for s in fleet.slots if s.role == "prefill"]
+        busy = max(pre, key=lambda s: s.inflight)
+        fleet.kill_worker(busy.idx)
+        for fr in frs:
+            assert fleet.result(fr, timeout=60) == _stub_chain(ids, 25)
+
+        # Leg 2: kill the decode worker holding spliced requests.
+        frs2 = [fleet.submit_ids(ids, _pv(10 + i), 40) for i in range(2)]
+        deadline = time.time() + 60
+        while time.time() < deadline and not any(
+                fleet.slots[fleet.worker_of(fr)].role == "decode"
+                for fr in frs2):
+            time.sleep(0.01)
+        victim = next(fleet.worker_of(fr) for fr in frs2
+                      if fleet.slots[fleet.worker_of(fr)].role == "decode")
+        fleet.kill_worker(victim)
+        for fr in frs2:
+            assert fleet.result(fr, timeout=90) == _stub_chain(ids, 40)
+        moved = [fr for fr in frs2 if fleet._requests[fr].failovers >= 1]
+        assert moved, "the decode kill moved nothing"
+        j = fleet.journey(moved[0])
+        kinds = [e["kind"] for e in j["events"]]
+        assert "worker_lost" in kinds
+        ev = next(e for e in j["events"] if e["kind"] == "failover")
+        assert ev["path"] == "redo"
+        # The redo landed back on the PREFILL side first, then shipped
+        # again: the final worker is a decode worker.
+        assert fleet.slots[fleet.worker_of(moved[0])].role == "decode"
+        assert sum(j["phases"].values()) == pytest.approx(
+            j["e2e_s"], abs=1e-6)
+    finally:
+        fleet.shutdown()
+
+
+# -- worker argv forwarding guard (ISSUE 17 satellite) -----------------------
+
+def test_worker_argv_round_trips_every_forwarded_flag():
+    """Every WORKER_FORWARDED_FLAGS entry survives the coordinator ->
+    argv -> worker parse round trip with a NON-DEFAULT value, so a
+    forwarded flag can never silently fail to cross the process
+    boundary."""
+    from eventgpt_tpu.cli.serve import (
+        WORKER_FORWARDED_FLAGS, _worker_argv, build_parser,
+    )
+
+    parser = build_parser()
+    args = parser.parse_args([])
+    choices = {"dtype": "float32", "quant": "int8", "kv_cache": "int8",
+               "kv_layout": "paged", "conv_mode": "plain"}
+    for dest, kind, default in WORKER_FORWARDED_FLAGS:
+        if kind == "flag":
+            setattr(args, dest, True)
+        elif dest in choices:
+            setattr(args, dest, choices[dest])
+        elif isinstance(default, (int, float)) and not isinstance(
+                default, bool):
+            setattr(args, dest, type(default)(default) + 3)
+        else:
+            setattr(args, dest, f"x_{dest}")
+    argv = _worker_argv(args)
+    assert argv[3] == "--worker"
+    back = parser.parse_args(argv[4:] + ["--worker"])
+    for dest, kind, default in WORKER_FORWARDED_FLAGS:
+        want = getattr(args, dest)
+        got = getattr(back, dest)
+        if kind == "value" and not isinstance(want, str):
+            got = type(want)(got)
+        assert got == want, f"--{dest} did not round-trip: " \
+                            f"{want!r} -> {got!r}"
+
+
+def test_every_parser_flag_is_classified():
+    """A NEW serving flag must be filed as forwarded, coordinator-only,
+    or per-slot — the regression that once ran paged-pool workers
+    dense. This guard fails the moment an unclassified flag appears."""
+    from eventgpt_tpu.cli.serve import (
+        WORKER_COORDINATOR_ONLY, WORKER_FORWARDED_FLAGS, WORKER_PER_SLOT,
+        build_parser,
+    )
+
+    forwarded = {dest for dest, _, _ in WORKER_FORWARDED_FLAGS}
+    assert not (forwarded & WORKER_COORDINATOR_ONLY)
+    assert not (forwarded & WORKER_PER_SLOT)
+    dests = {a.dest for a in build_parser()._actions
+             if a.dest != "help"}
+    unclassified = dests - forwarded - WORKER_COORDINATOR_ONLY \
+        - WORKER_PER_SLOT
+    assert not unclassified, (
+        f"unclassified serving flags {sorted(unclassified)}: add each "
+        f"to WORKER_FORWARDED_FLAGS (crosses to workers), "
+        f"WORKER_COORDINATOR_ONLY, or WORKER_PER_SLOT in cli/serve.py")
+    missing = (forwarded | WORKER_PER_SLOT) - dests
+    assert not missing, f"declared but not in the parser: {missing}"
+
+
+def test_http_fleet_and_stats_expose_role_topology():
+    """GET /fleet and GET /stats over the real HTTP handler, both
+    topologies: the colocated fleet reports roles=None with zeroed
+    handoff totals (stable shape, no feature detection), the
+    disaggregated fleet reports the role string, per-worker roles +
+    pool headroom, and live handoff totals."""
+    import json as _json
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    from eventgpt_tpu.cli.serve import make_handler
+
+    def _serve(fleet):
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                    make_handler(fleet, None))
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        return httpd
+
+    def _get(httpd, path):
+        url = f"http://127.0.0.1:{httpd.server_address[1]}{path}"
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return _json.loads(r.read().decode())
+
+    fleet = _fleet()
+    httpd = _serve(fleet)
+    try:
+        fl = _get(httpd, "/fleet")
+        assert fl["roles"] is None
+        assert fl["handoffs"]["shipped"] == 0
+        assert fl["policy"]["handoff_retries"] == 3
+        assert [w["role"] for w in fl["per_worker"]] == \
+            ["colocated", "colocated"]
+    finally:
+        httpd.shutdown()
+        fleet.shutdown()
+
+    fleet = _disagg_fleet("1:1")
+    httpd = _serve(fleet)
+    try:
+        ids = [1, 2, EVENT, 7]
+        fr = fleet.submit_ids(ids, _pv(1), 6)
+        assert fleet.result(fr, timeout=60) == _stub_chain(ids, 6)
+        fl = _get(httpd, "/fleet")
+        assert fl["roles"] == "1:1"
+        assert [w["role"] for w in fl["per_worker"]] == \
+            ["prefill", "decode"]
+        assert fl["handoffs"]["shipped"] == 1
+        assert fl["handoffs"]["bytes"] == 4 * len(ids)
+        assert fl["handoffs"]["gathered"] >= 1
+        assert fl["handoffs"]["spliced"] >= 1
+        assert all(w["kv_free_blocks"] is not None
+                   for w in fl["per_worker"])
+        st = _get(httpd, "/stats")
+        assert st["fleet"]["roles"] == "1:1"
+        assert st["fleet"]["handoffs"]["shipped"] == 1
+    finally:
+        httpd.shutdown()
+        fleet.shutdown()

@@ -238,3 +238,107 @@ def test_proc_fleet_serves_http_unchanged(tiny, tmp_path):
         httpd.shutdown()
         httpd.server_close()
         fleet.shutdown()
+
+
+# -- prefill/decode disaggregation (ISSUE 17) --------------------------------
+
+DISAGG_CMD = WORKER_CMD + ["--kv_layout", "paged", "--kv_pool_blocks", "12"]
+
+
+def _disagg_fleet(**kw):
+    kw.setdefault("spawn_timeout_s", 300)
+    kw.setdefault("probe_interval_s", 0.03)
+    kw.setdefault("respawn_backoff_s", 0.05)
+    return ProcFleet(DISAGG_CMD, 4, tokenizer=load_tokenizer("byte"),
+                     roles="2:2", **kw)
+
+
+def test_disagg_handoff_and_role_aware_kills_byte_identical(tiny):
+    """Real engines, 2 prefill + 2 decode workers: the paged-KV handoff
+    crosses the raw RPC frame and splices through the same admission
+    executable, so every chain is byte-identical to the single-engine
+    reference — through a clean run, a SIGKILLed PREFILL worker
+    (mid-gather: its victims redo onto the surviving prefill worker),
+    and a SIGKILLed DECODE worker (post-splice: the spliced KV died
+    with it, so the redo runs a fresh prefill -> handoff chain)."""
+    cfg, _ = tiny
+    reqs = [(_ids((60 + i,)), _pv(cfg, 600 + i), 20) for i in range(4)]
+    ref = _reference_chains(tiny, reqs)
+
+    fleet = _disagg_fleet()
+    try:
+        assert [s.role for s in fleet.slots] == \
+            ["prefill", "prefill", "decode", "decode"]
+
+        # ---- leg 0: clean disaggregated serving ----
+        frids = [fleet.submit_ids(ids, pv, n) for ids, pv, n in reqs]
+        out = [fleet.result(f, timeout=300) for f in frids]
+        assert out == ref, "disaggregated chains diverged (clean run)"
+        assert fleet.n_handoffs >= len(reqs)
+        assert fleet.n_handoff_redos == 0
+        for f in frids:
+            assert fleet.slots[fleet.worker_of(f)].role == "decode"
+        j = fleet.journey(frids[0])
+        ev = next(e for e in j["events"] if e["kind"] == "kv_handoff")
+        assert ev["stage"] == "shipped" and ev["bytes"] > 0
+        assert fleet.slots[ev["from_worker"]].role == "prefill"
+        assert fleet.slots[ev["to_worker"]].role == "decode"
+        assert j["phases"]["handoff_s"] > 0.0
+        assert j["phases"]["admission_s"] > 0.0
+        assert sum(j["phases"].values()) == pytest.approx(
+            j["e2e_s"], abs=1e-6)
+        st = fleet.stats()["fleet"]
+        assert st["roles"] == "2:2"
+        assert st["handoffs"]["shipped"] >= len(reqs)
+        assert st["handoffs"]["bytes"] > 0
+
+        # ---- leg 1: SIGKILL a prefill worker mid-gather ----
+        frids1 = [fleet.submit_ids(ids, pv, n) for ids, pv, n in reqs]
+        pre = [s for s in fleet.slots if s.role == "prefill"]
+        busy = max(pre, key=lambda s: s.inflight)
+        fleet.kill_worker(busy.idx)
+        out1 = [fleet.result(f, timeout=300) for f in frids1]
+        assert out1 == ref, "prefill-kill chains diverged"
+        moved1 = [f for f in frids1
+                  if fleet._requests[f].failovers >= 1]
+        assert moved1, "the prefill kill moved nothing"
+        ev = next(e for e in fleet.journey(moved1[0])["events"]
+                  if e["kind"] == "failover")
+        assert ev["path"] == "redo"
+        assert fleet.slots[ev["to_worker"]].role == "prefill"
+
+        # ---- leg 2: SIGKILL a decode worker post-splice ----
+        _wait(lambda: all(s.state == "ok" for s in fleet.slots), 300,
+              "the killed prefill slot to respawn")
+        reqs2 = [(_ids((70 + i,)), _pv(cfg, 700 + i), 48)
+                 for i in range(2)]
+        ref2 = _reference_chains(tiny, reqs2)
+        frids2 = [fleet.submit_ids(ids, pv, n) for ids, pv, n in reqs2]
+        _wait(lambda: any(
+            fleet.slots[fleet.worker_of(f)].role == "decode"
+            for f in frids2), 300, "a spliced decode leg")
+        victim = next(fleet.worker_of(f) for f in frids2
+                      if fleet.slots[fleet.worker_of(f)].role == "decode")
+        fleet.kill_worker(victim)
+        out2 = [fleet.result(f, timeout=300) for f in frids2]
+        assert out2 == ref2, "decode-kill chains diverged"
+        moved2 = [f for f in frids2
+                  if fleet._requests[f].failovers >= 1]
+        assert moved2, "the decode kill moved nothing"
+        _wait(lambda: all((obs_journey.get(fleet._journey_owner, f)
+                           or {}).get("finished") for f in moved2),
+              60, "journeys to close")
+        j2 = fleet.journey(moved2[0])
+        kinds = [e["kind"] for e in j2["events"]]
+        assert "worker_lost" in kinds
+        ev = next(e for e in j2["events"] if e["kind"] == "failover")
+        assert ev["path"] == "redo"
+        # The redo re-prefilled and re-shipped: the final assignment is
+        # a decode worker again, and the stitched three-leg timeline
+        # keeps the exact phase-sum invariant.
+        assert fleet.slots[fleet.worker_of(moved2[0])].role == "decode"
+        assert j2["phases"]["failover_redo_s"] > 0.0
+        assert sum(j2["phases"].values()) == pytest.approx(
+            j2["e2e_s"], abs=1e-6)
+    finally:
+        fleet.shutdown()

@@ -1,0 +1,346 @@
+"""Prefill/decode disaggregation, in-process half (ISSUE 17): a
+prefill-role ``ContinuousBatcher`` gathers every activated row into the
+handoff outbox, the record crosses the raw-binary RPC frame
+(``rpc.dumps_frame``/``loads_frame`` — the actual wire encoding, not a
+mock), and a decode-role batcher splices it through the same paged
+admission executable. The bar is the one every scheduler change rides:
+disaggregation is a PLACEMENT decision, never a numerics one — the
+greedy chain of a handed-off request is byte-identical to its colocated
+one-shot run across the plain / int8-KV / speculative / mixed-lane
+configs. Role validation, import gates, deadline/SLO preservation and
+the worker handler's replay/dedup contract live here too; the
+coordinator-level routing/chaos tests are in tests/test_fleet_proc.py
+and the real-worker SIGKILL legs in tests/test_fleet_proc_chaos.py."""
+
+import jax
+import numpy as np
+import pytest
+
+from eventgpt_tpu import faults, rpc
+from eventgpt_tpu.config import EventChatConfig
+from eventgpt_tpu.models import eventchat
+from eventgpt_tpu.obs import journey as obs_journey
+from eventgpt_tpu.serve import ContinuousBatcher
+from eventgpt_tpu.workload import SLO
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disable()
+    yield
+    faults.disable()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = EventChatConfig.tiny()
+    params = eventchat.init_eventchat_params(cfg, jax.random.PRNGKey(5))
+    return cfg, params
+
+
+def _pv(cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(cfg.num_event_frames, 3, cfg.vision.image_size,
+                            cfg.vision.image_size)).astype(np.float32)
+
+
+IDS = [1, 5, -200, 9, 9]
+BUDGET = 24
+
+
+def _batcher(params, cfg, **kw):
+    kw.setdefault("kv_pool_blocks", 12)
+    return ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                             eos_token_id=None, kv_layout="paged", **kw)
+
+
+def _one_shot(params, cfg, ids, pv, budget, **kw):
+    """The colocated reference: one request, one engine, ample pool."""
+    srv = _batcher(params, cfg, **kw)
+    rid = srv.submit(ids, pv, budget)
+    return srv.run_until_drained()[rid]
+
+
+def _gather_one(pre, ids, pv, budget, **submit_kw):
+    """Submit to a prefill-role batcher and step until its outbox holds
+    the gathered record."""
+    rid = pre.submit(ids, pv, budget, **submit_kw)
+    for _ in range(400):
+        if pre.handoff_ready:
+            break
+        pre.step()
+    else:
+        pytest.fail("prefill role never gathered the row into the outbox")
+    out = pre.pop_handoffs()
+    assert len(out) == 1 and out[0]["rid"] == rid
+    return out[0]
+
+
+def _wire(out):
+    """Round-trip one outbox record through the ACTUAL wire encoding.
+    The KV planes are ndarrays, so the frame must take the raw-binary
+    form (blob bytes verbatim, no b64 inflation)."""
+    buf = rpc.dumps_frame(out)
+    assert buf.startswith(rpc.RAW_MAGIC)
+    return rpc.loads_frame(buf)
+
+
+def _splice_and_drain(dec, out):
+    rid2 = dec.import_handoff(
+        out["input_ids"], out["max_new_tokens"], out["rec"],
+        tokens=out["tokens"], prompt_len=out["prompt_len"],
+        deadline_s=out["deadline_s"], slo=out["slo"])
+    return dec.run_until_drained()[rid2]
+
+
+# -- chain exactness across the wire ----------------------------------------
+
+@pytest.mark.parametrize("kw", [
+    dict(),
+    dict(kv_quant=True),
+    dict(speculative=4),
+    dict(prefill_budget=2, prefill_chunk=4),
+], ids=["plain", "int8_kv", "speculative", "mixed_lane"])
+def test_handoff_chain_byte_identical(tiny, kw):
+    """prefill-gather -> raw frame -> decode-splice produces the SAME
+    greedy chain as the colocated one-shot, in every serving config the
+    admission path supports (int8 KV ships scale planes, speculative
+    ships ids_buf/base_pos, mixed-lane exercises the budget-zeroing
+    prefill role)."""
+    cfg, params = tiny
+    pv = _pv(cfg, 3)
+    ref = _one_shot(params, cfg, IDS, pv, BUDGET, **kw)
+    assert len(ref) == BUDGET
+
+    pre = _batcher(params, cfg, role="prefill", **kw)
+    dec = _batcher(params, cfg, role="decode", **kw)
+    out = _gather_one(pre, IDS, pv, BUDGET)
+    # The gather released the row's whole reservation (the prefix cache
+    # may retain its own aliased blocks — that is cache residency, not
+    # leakage: refcounts and the free list stay consistent).
+    st = pre._pool.stats()
+    assert st["free_blocks"] + st["used_blocks"] == st["usable_blocks"]
+    assert all(r is None for r in pre.rows)
+    assert pre.handoffs_gathered == 1
+    assert out["rec"]["n_blocks"] >= 1
+    assert out["rec"]["n_total"] >= out["rec"]["n_blocks"]
+
+    chain = _splice_and_drain(dec, _wire(out))
+    assert chain == ref
+    assert dec.handoffs_spliced == 1
+    # The decode side released the splice's re-allocation at finish.
+    st = dec._pool.stats()
+    assert st["free_blocks"] + st["used_blocks"] == st["usable_blocks"]
+
+
+def test_handoff_interleaves_with_native_decode_traffic(tiny):
+    """A decode worker is not a handoff-only device: an imported splice
+    and a locally-submitted request decode side by side, both
+    byte-identical to their solo runs."""
+    cfg, params = tiny
+    pv_a, pv_b = _pv(cfg, 0), _pv(cfg, 1)
+    ids_b = [3, -200, 11, 4]
+    ref_a = _one_shot(params, cfg, IDS, pv_a, BUDGET)
+    ref_b = _one_shot(params, cfg, ids_b, pv_b, 12)
+
+    pre = _batcher(params, cfg, role="prefill")
+    dec = _batcher(params, cfg, role="decode")
+    out = _wire(_gather_one(pre, IDS, pv_a, BUDGET))
+    rid_b = dec.submit(ids_b, pv_b, 12)
+    rid_a = dec.import_handoff(
+        out["input_ids"], out["max_new_tokens"], out["rec"],
+        tokens=out["tokens"], prompt_len=out["prompt_len"])
+    got = dec.run_until_drained()
+    assert got[rid_a] == ref_a
+    assert got[rid_b] == ref_b
+
+
+def test_handoff_outbox_record_and_journey_shape(tiny):
+    """The outbox record is the complete re-activation contract: ids,
+    committed tokens, budget, remaining-deadline headroom, the SLO
+    object, and the CLOSED prefill-leg journey (kind=kv_handoff
+    stage=gathered; terminal status 'handoff') the coordinator stitches
+    from."""
+    cfg, params = tiny
+    obs_journey.configure(64)
+    try:
+        pre = _batcher(params, cfg, role="prefill")
+        out = _gather_one(pre, IDS, _pv(cfg, 2), BUDGET,
+                          deadline_s=30.0,
+                          slo=SLO(name="interactive", ttft_s=5.0))
+        assert out["input_ids"] == IDS
+        assert out["max_new_tokens"] == BUDGET
+        assert out["prompt_len"] >= len(IDS)
+        # Remaining headroom, not the original budget: time already
+        # spent prefilling is gone.
+        assert 0 < out["deadline_s"] < 30.0
+        assert out["slo"].name == "interactive"
+        # Whole-life accounting rides as DURATIONS: the prefill leg's
+        # elapsed wall time, so the decode worker can rebase its clock
+        # and score TTFT / latency / SLO over the request's whole life.
+        # Plain admission commits no token at activation, so the
+        # shipped commit-time TTFT is honestly absent (the first commit
+        # lands on the decode worker, AFTER the rebased t_submit).
+        assert out["elapsed_s"] > 0.0
+        assert out["ttft_s"] is None
+        j = out["journey"]
+        assert j is not None and j["finished"] and j["status"] == "handoff"
+        kinds = [e["kind"] for e in j["events"]]
+        assert "kv_handoff" in kinds
+        ev = next(e for e in j["events"] if e["kind"] == "kv_handoff")
+        assert ev["stage"] == "gathered"
+        assert ev["bytes"] == out["rec"]["nbytes_kv"] > 0
+        # Wire round-trip preserves all of it (SLO via the __slo__
+        # allowlist, the journey as plain JSON).
+        w = _wire(out)
+        assert w["slo"] == out["slo"]
+        assert w["journey"]["status"] == "handoff"
+        assert w["deadline_s"] == pytest.approx(out["deadline_s"])
+    finally:
+        obs_journey.disable()
+
+
+def test_import_preserves_deadline_and_slo(tiny):
+    """The decode side re-arms the shipped deadline headroom and SLO
+    class; an unknown SLO class is refused at the import boundary."""
+    cfg, params = tiny
+    pre = _batcher(params, cfg, role="prefill")
+    dec = _batcher(params, cfg, role="decode")
+    out = _wire(_gather_one(pre, IDS, _pv(cfg, 4), 8,
+                            deadline_s=60.0,
+                            slo=SLO(name="batch", latency_s=60.0)))
+    rid2 = dec.import_handoff(
+        out["input_ids"], out["max_new_tokens"], out["rec"],
+        tokens=out["tokens"], prompt_len=out["prompt_len"],
+        deadline_s=out["deadline_s"], slo=out["slo"])
+    req = next(r for r in dec.queue if r.rid == rid2)
+    assert req.deadline is not None
+    assert req.slo is not None and req.slo.name == "batch"
+    assert dec.run_until_drained()[rid2] == _one_shot(
+        params, cfg, IDS, _pv(cfg, 4), 8)
+
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        dec.import_handoff(IDS, 4, dict(out["rec"]),
+                           slo=SLO(name="platinum", ttft_s=1.0))
+
+
+# -- role validation + import gates -----------------------------------------
+
+def test_role_validation(tiny):
+    cfg, params = tiny
+    with pytest.raises(ValueError, match="role must be"):
+        _batcher(params, cfg, role="draft")
+    # Split roles move block runs: the dense layout has none to move.
+    with pytest.raises(ValueError, match="requires kv_layout='paged'"):
+        ContinuousBatcher(params, cfg, max_batch=2, max_len=256, chunk=4,
+                          eos_token_id=None, kv_layout="dense",
+                          role="prefill")
+
+
+def test_prefill_role_rejects_import_and_never_decodes(tiny):
+    cfg, params = tiny
+    pre = _batcher(params, cfg, role="prefill")
+    with pytest.raises(ValueError, match="prefill-role"):
+        pre.import_handoff(IDS, 4, {"n_blocks": 1})
+    out = _gather_one(pre, IDS, _pv(cfg, 5), BUDGET)
+    # Admission-only: the gathered request committed no decode tokens
+    # beyond its prefill argmax, and nothing is left on the rows.
+    assert len(out["tokens"]) < BUDGET
+    assert all(r is None for r in pre.rows)
+    assert pre.finished == {}
+
+
+def test_import_gate_rejects_oversized_reservation(tiny):
+    """The fit pre-check fires BEFORE any allocation: a handoff whose
+    full reservation cannot ever fit this pool is refused loudly (the
+    coordinator's retry loop then tries another decode worker)."""
+    cfg, params = tiny
+    dec = _batcher(params, cfg, role="decode", kv_pool_blocks=4)
+    with pytest.raises(ValueError, match="does not fit"):
+        dec.import_handoff(IDS, 200, {"n_blocks": 1}, prompt_len=250)
+
+
+# -- the worker handler's at-least-once delivery contract -------------------
+
+def test_worker_handler_replay_until_ack_and_hid_dedup():
+    """Jax-free: ``collect_handoffs`` re-serves unacked records (a
+    collect response lost in transit replays instead of stranding KV)
+    and ``import_handoff`` dedups on the coordinator's hid — a retried
+    ship returns the ORIGINAL rid and never splices twice."""
+    from eventgpt_tpu.fleet_proc import WorkerHandler, _StubEngine
+
+    pre = _StubEngine(token_delay_s=0.001, role="prefill")
+    h = WorkerHandler(pre)
+    pre.submit_ids([2, 3, 4], None, 6)
+    deadline = 200
+    recs = []
+    while not recs and deadline:
+        recs = h("collect_handoffs", {})
+        deadline -= 1
+        import time
+        time.sleep(0.005)
+    assert len(recs) == 1
+    # Unacked: the same record re-serves on the next collect.
+    again = h("collect_handoffs", {})
+    assert [r["rid"] for r in again] == [recs[0]["rid"]]
+    h("ack_handoffs", {"rids": [recs[0]["rid"]]})
+    assert h("collect_handoffs", {}) == []
+
+    dec = _StubEngine(token_delay_s=0.001, role="decode")
+    hd = WorkerHandler(dec)
+    p = {"hid": "0:7", "input_ids": [2, 3, 4], "max_new_tokens": 6,
+         "tokens": [], "prompt_len": 3,
+         "rec": {"kv": np.asarray([2, 3, 4], np.int32)}}
+    rid_a = hd("import_handoff", p)
+    rid_b = hd("import_handoff", dict(p))  # the retried ship
+    assert rid_a == rid_b
+    assert dec.handoffs_spliced == 1
+
+    # A CORRUPTED KV plane is refused, not decoded: the stub's transport
+    # contract that makes the fleet tests assert bit-exact raw frames.
+    bad = {**p, "hid": "0:8",
+           "rec": {"kv": np.asarray([2, 3, 5], np.int32)}}
+    with pytest.raises(ValueError, match="corrupted in transit"):
+        hd("import_handoff", bad)
+
+
+def test_import_rebases_stats_to_whole_life(tiny):
+    """A handed-off request's request_stats must score its WHOLE life
+    (prefill leg + wire + decode), not the decode leg alone: the import
+    rebases t_submit into the past by the shipped ``elapsed_s`` (and,
+    when the prefill leg committed t0, pins t_first at its commit
+    offset) — so disagg TTFT/latency/SLO attainment are comparable to
+    a colocated run's instead of over-crediting."""
+    import time
+
+    cfg, params = tiny
+    pre = _batcher(params, cfg, role="prefill")
+    dec = _batcher(params, cfg, role="decode")
+    out = _gather_one(pre, IDS, _pv(cfg, 6), 8)
+    wire_gap_s = 0.05
+    time.sleep(wire_gap_s)
+    elapsed = out["elapsed_s"] + wire_gap_s
+    rid2 = dec.import_handoff(
+        out["input_ids"], out["max_new_tokens"], out["rec"],
+        tokens=out["tokens"], prompt_len=out["prompt_len"],
+        elapsed_s=elapsed, ttft_s=out["ttft_s"])
+    dec.run_until_drained()
+    st = dec.request_stats[rid2]
+    # Plain admission ships no commit-time TTFT (nothing committed on
+    # the prefill leg), so the decode worker's FIRST commit closes the
+    # whole-life TTFT: prefill + wire gap + first decode step.
+    assert st["ttft_s"] > elapsed
+    assert st["latency_s"] > elapsed
+    assert st["latency_s"] >= st["ttft_s"]
+
+    # A shipped commit-time TTFT pins t_first verbatim: the first token
+    # existed BEFORE the wire, and the decode leg's own first commit
+    # must not overwrite it.
+    out2 = _gather_one(pre, [1, 5, -200, 9, 2], _pv(cfg, 7), 8)
+    rid3 = dec.import_handoff(
+        out2["input_ids"], out2["max_new_tokens"], out2["rec"],
+        tokens=out2["tokens"], prompt_len=out2["prompt_len"],
+        elapsed_s=out2["elapsed_s"], ttft_s=0.011)
+    dec.run_until_drained()
+    assert dec.request_stats[rid3]["ttft_s"] == pytest.approx(
+        0.011, abs=1e-6)
